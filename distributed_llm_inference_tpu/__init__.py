@@ -1,0 +1,22 @@
+"""TPU-native distributed LLM inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``Dylan102938/distributed-llm-inference`` (block-sharded distributed inference
+with multi-tenant KV caches, batched serving, per-block weight streaming,
+compiled decode, quantization), built TPU-first: SPMD over ``jax.sharding.Mesh``
+for tensor/pipeline/data/sequence parallelism, Pallas kernels for the attention
+hot paths, and a native relay for the cross-host (DCN) hop.
+"""
+
+from .config import CacheConfig, EngineConfig, MeshConfig, ModelConfig, RopeScaling
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CacheConfig",
+    "EngineConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "RopeScaling",
+    "__version__",
+]

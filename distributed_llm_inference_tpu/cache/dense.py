@@ -1,0 +1,107 @@
+"""Dense (contiguous, preallocated) KV cache.
+
+The simplest of the three cache policies (dense / paged / sink). Unlike the
+reference's ``torch.cat`` growth pattern
+(``/root/reference/distributed_llm_inference/models/llama/cache.py:108-109``),
+the buffer is preallocated at ``max_seq_len`` and written with per-row
+``dynamic_update_slice`` — XLA requires static shapes, and a fixed buffer also
+means decode steps always hit the same compiled executable (the role CUDA-graph
+capture plays in the reference, ``utils/cuda.py:6``).
+
+Batch rows are independent sessions with their own write offsets
+(``lengths``), which is what makes continuous batching possible: the
+``generation_id``-keyed dict-of-tensors in the reference
+(``models/llama/cache.py:14-19``) becomes integer slot indexing into the batch
+dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops.attention import causal_mask
+from ..ops.rotary import RopeAngles, apply_rope
+
+
+class DenseKVCache(struct.PyTreeNode):
+    """``k``/``v``: ``[L, B, T, Hkv, D]`` (keys stored rotated); ``lengths``: ``[B]``."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        batch: int,
+        max_seq_len: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "DenseKVCache":
+        shape = (num_layers, batch, max_seq_len, num_kv_heads, head_dim)
+        return DenseKVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    def q_positions(self, seq_len: int) -> jnp.ndarray:
+        """Absolute positions of the incoming tokens: ``[B, S]``."""
+        return self.lengths[:, None] + jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def fits(self, num_new) -> jnp.ndarray:
+        """Per-row: can ``num_new`` more tokens be appended without overflow?
+
+        The scheduler MUST check this before admitting tokens: past capacity,
+        ``dynamic_update_slice`` clamps the write offset and the cache silently
+        corrupts (engine contract, enforced in ``engine/scheduler.py``).
+        """
+        return self.lengths + num_new <= self.max_len
+
+    def update_and_gather(
+        self,
+        layer_k: jnp.ndarray,
+        layer_v: jnp.ndarray,
+        q: jnp.ndarray,
+        k_new: jnp.ndarray,
+        v_new: jnp.ndarray,
+        rope: RopeAngles,
+        q_pos: jnp.ndarray,
+        num_new: jnp.ndarray,
+        sliding_window: Optional[int] = None,
+    ) -> Tuple[jnp.ndarray, ...]:
+        """Rotate q/k, write k/v into this layer's buffer, build the mask.
+
+        ``layer_k``/``layer_v``: ``[B, T, Hkv, D]`` (one layer's slice, as
+        delivered by ``lax.scan`` over the leading layer axis). ``rope`` holds
+        cos/sin precomputed once per block for ``q_pos``.
+        Returns ``(q_rot, k_all, v_all, mask, new_layer_k, new_layer_v)``.
+        """
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+
+        def write_row(buf, val, start):
+            return jax.lax.dynamic_update_slice(buf, val, (start, 0, 0))
+
+        new_k = jax.vmap(write_row)(layer_k, k_rot, self.lengths)
+        new_v = jax.vmap(write_row)(layer_v, v_new, self.lengths)
+
+        t = layer_k.shape[1]
+        kv_pos = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None, :], (q.shape[0], t)
+        )
+        kv_valid = kv_pos < (self.lengths + num_new)[:, None]
+        mask = causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
+        return q_rot, new_k, new_v, mask, new_k, new_v
+
+    def advance(self, num_new: jnp.ndarray) -> "DenseKVCache":
+        return self.replace(lengths=self.lengths + num_new)

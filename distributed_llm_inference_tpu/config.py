@@ -1,0 +1,168 @@
+"""Configuration dataclasses for the TPU-native distributed inference framework.
+
+The reference has no config system — configuration is plain kwargs
+(``/root/reference/distributed_llm_inference/utils/model.py:75-80``,
+``models/llama/cache.py:11``) plus HF ``AutoConfig``. Here everything is a
+frozen dataclass so configs are hashable and can be closed over by ``jax.jit``
+as static arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Rotary-embedding scaling (Llama-3 style "llama3" or linear/dynamic)."""
+
+    rope_type: str = "default"  # "default" | "llama3" | "linear"
+    factor: float = 1.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+    @staticmethod
+    def from_hf(d: Optional[Mapping[str, Any]]) -> Optional["RopeScaling"]:
+        if d is None:
+            return None
+        return RopeScaling(
+            rope_type=d.get("rope_type", d.get("type", "default")),
+            factor=float(d.get("factor", 1.0)),
+            low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+            original_max_position_embeddings=int(
+                d.get("original_max_position_embeddings", 8192)
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only transformer.
+
+    Covers the Llama family (the reference's only model family —
+    ``/root/reference/distributed_llm_inference/models/llama/model.py``) plus
+    Mistral (``sliding_window``), Qwen2 (``qkv_bias``) and Mixtral-style MoE
+    (``num_experts``/``num_experts_per_tok``).
+    """
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[RopeScaling] = None
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    # Mistral-style sliding-window attention; None = full causal.
+    sliding_window: Optional[int] = None
+    # Qwen2-style bias on q/k/v projections.
+    qkv_bias: bool = False
+    # MoE (Mixtral): 0 experts = dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Model family tag ("llama", "mistral", "qwen2", "mixtral").
+    family: str = "llama"
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @staticmethod
+    def from_hf_config(hf: Any) -> "ModelConfig":
+        """Build from a ``transformers`` PretrainedConfig (or plain dict)."""
+        get = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+            lambda k, d=None: getattr(hf, k, d)
+        )
+        model_type = get("model_type", "llama")
+        num_heads = get("num_attention_heads", 32)
+        hidden = get("hidden_size", 4096)
+        return ModelConfig(
+            vocab_size=get("vocab_size", 32000),
+            hidden_size=hidden,
+            intermediate_size=get("intermediate_size", 11008),
+            num_layers=get("num_hidden_layers", 32),
+            num_heads=num_heads,
+            num_kv_heads=get("num_key_value_heads", num_heads) or num_heads,
+            head_dim=get("head_dim", None) or hidden // num_heads,
+            rms_norm_eps=get("rms_norm_eps", 1e-5),
+            rope_theta=get("rope_theta", 10000.0),
+            rope_scaling=RopeScaling.from_hf(get("rope_scaling", None)),
+            max_position_embeddings=get("max_position_embeddings", 4096),
+            tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+            sliding_window=get("sliding_window", None),
+            qkv_bias=bool(get("attention_bias", False)) or model_type in ("qwen2",),
+            num_experts=get("num_local_experts", 0) or 0,
+            num_experts_per_tok=get("num_experts_per_tok", 2) or 2,
+            family=model_type,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axes: data, pipeline(stage), tensor, sequence.
+
+    Replaces the reference's (absent) process-group story: the vestigial
+    single-device ``pretraining_tp`` weight slicing at
+    ``/root/reference/distributed_llm_inference/models/llama/modules.py:44-59``
+    becomes real multi-device TP via ``jax.sharding.Mesh`` + NamedSharding.
+    """
+
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1  # sequence/context parallel degree
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("dp", "pp", "tp", "sp")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.tp, self.sp)
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """KV-cache policy.
+
+    ``window_length``/``num_sink_tokens`` carry the reference's signature
+    StreamingLLM sink-cache capability
+    (``/root/reference/distributed_llm_inference/models/llama/cache.py:11``)
+    into a static-shape design; paged parameters size the vLLM-style paged
+    pool used for bounded-context serving.
+    """
+
+    kind: str = "paged"  # "paged" | "sink" | "dense"
+    max_sessions: int = 32
+    page_size: int = 64
+    num_pages: int = 512
+    max_pages_per_session: int = 64
+    # sink-cache policy (kind == "sink")
+    window_length: int = 1024
+    num_sink_tokens: int = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving engine policy: batching, buckets, dtypes, quantization."""
+
+    max_batch_size: int = 8
+    prefill_buckets: Tuple[int, ...] = (128, 512, 2048)
+    max_seq_len: int = 4096
+    max_new_tokens: int = 512
+    dtype: str = "bfloat16"
+    quantization: Optional[str] = None  # None | "int8"
+    use_pallas_attention: bool = False
+    # speculative decoding
+    speculative_k: int = 0  # 0 = disabled

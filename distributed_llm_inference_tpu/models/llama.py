@@ -1,0 +1,291 @@
+"""Llama-family decoder stack as pure JAX functions.
+
+TPU-first re-expression of the reference's model layer
+(``/root/reference/distributed_llm_inference/models/llama/model.py`` and
+``modules.py``). Design notes:
+
+* ``LlamaBlock`` — a module holding a Python list of decoder layers iterated in
+  a Python loop (``model.py:22,59-71``) — becomes ``block_apply``: a pure
+  function over *stacked* layer parameters driven by ``lax.scan``, so compile
+  time is O(1) in depth and the whole block is one XLA computation.
+* The CUDA-graphed decode fast paths (``modules.py:73-76,159-162,176-179``)
+  disappear: ``jax.jit`` of the step function is the graph.
+* The vestigial single-device ``pretraining_tp`` weight slicing
+  (``modules.py:44-59,107-110``) is dropped; real tensor parallelism is applied
+  externally via ``NamedSharding`` on these same parameter arrays
+  (see ``parallel/tp.py``).
+* Like the reference's block (``model.py:16-76``), ``block_apply`` is strictly a
+  hidden-states→hidden-states pipeline stage; embedding / final norm / lm_head
+  live in ``model_apply`` (the client-side layers the reference never wrote,
+  SURVEY §1).
+
+Weight layout: all projections are stored ``[in_features, out_features]``
+(transposed from torch ``nn.Linear``) so the forward is plain ``x @ w``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..ops.attention import gqa_attention
+from ..ops.norms import rms_norm
+from ..ops.rotary import RopeAngles, rope_cos_sin, rope_inv_freq
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_layer_params(
+    cfg: ModelConfig, key: jax.Array, num_layers: int, dtype=jnp.bfloat16
+) -> Params:
+    """Random (normal 0.02) stacked parameters for ``num_layers`` decoder layers."""
+    h, d = cfg.hidden_size, cfg.head_dim
+    hq, hkv, inter = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    keys = jax.random.split(key, 8)
+
+    def w(k, *shape):
+        return (jax.random.normal(k, (num_layers, *shape), jnp.float32) * 0.02).astype(
+            dtype
+        )
+
+    p = {
+        "attn_norm": jnp.ones((num_layers, h), dtype),
+        "wq": w(keys[0], h, hq * d),
+        "wk": w(keys[1], h, hkv * d),
+        "wv": w(keys[2], h, hkv * d),
+        "wo": w(keys[3], hq * d, h),
+        "mlp_norm": jnp.ones((num_layers, h), dtype),
+        "wg": w(keys[4], h, inter),
+        "wu": w(keys[5], h, inter),
+        "wd": w(keys[6], inter, h),
+    }
+    if cfg.qkv_bias:
+        p["bq"] = jnp.zeros((num_layers, hq * d), dtype)
+        p["bk"] = jnp.zeros((num_layers, hkv * d), dtype)
+        p["bv"] = jnp.zeros((num_layers, hkv * d), dtype)
+    return p
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Full-model parameters (embedding + stacked layers + head)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    params: Params = {
+        "embed": (
+            jax.random.normal(k_embed, (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+            * 0.02
+        ).astype(dtype),
+        "layers": init_layer_params(cfg, k_layers, cfg.num_layers, dtype),
+        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(k_head, (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+            * 0.02
+        ).astype(dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _decoder_layer(
+    cfg: ModelConfig,
+    p: Params,
+    x: jnp.ndarray,
+    layer_k: jnp.ndarray,
+    layer_v: jnp.ndarray,
+    cache,
+    rope: RopeAngles,
+    q_pos: jnp.ndarray,
+    num_new: jnp.ndarray,
+    attention_fn=gqa_attention,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder layer: pre-norm attention + pre-norm SwiGLU MLP.
+
+    Mirrors the reference layer structure (``modules.py:146-184``) minus its
+    double-residual deviation (SURVEY §2.9.3).
+    """
+    b, s, _ = x.shape
+    hq, hkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = h @ p["wq"]
+    k = h @ p["wk"]
+    v = h @ p["wv"]
+    # Biases applied iff the checkpoint carries them (HF `attention_bias`).
+    if "bq" in p:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    q = q.reshape(b, s, hq, d)
+    k = k.reshape(b, s, hkv, d)
+    v = v.reshape(b, s, hkv, d)
+
+    q_rot, k_all, v_all, mask, new_k, new_v = cache.update_and_gather(
+        layer_k, layer_v, q, k, v, rope, q_pos, num_new,
+        sliding_window=cfg.sliding_window,
+    )
+    attn = attention_fn(q_rot, k_all, v_all, mask, scale=d**-0.5)
+    o = attn.reshape(b, s, hq * d) @ p["wo"]
+    if "bo" in p:
+        o = o + p["bo"]
+    x = x + o
+
+    h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(h2 @ p["wg"]) * (h2 @ p["wu"])) @ p["wd"]
+    return x + mlp, new_k, new_v
+
+
+def block_apply(
+    cfg: ModelConfig,
+    layer_params: Params,
+    x: jnp.ndarray,
+    cache,
+    num_new: jnp.ndarray,
+    attention_fn=gqa_attention,
+):
+    """Run a block (contiguous or not) of decoder layers over hidden states.
+
+    The pipeline-stage analog of ``LlamaBlock.forward``
+    (``/root/reference/distributed_llm_inference/models/llama/model.py:25-76``):
+    hidden states in, hidden states out, cache threaded explicitly. ``cache``
+    holds stacked per-layer k/v with leading dim equal to this block's layer
+    count; ``lax.scan`` slices one layer's params+cache per step.
+
+    Returns ``(x, cache)`` with the cache's k/v updated (lengths NOT advanced —
+    call ``cache.advance(num_new)`` after the last block of the model so that
+    multiple blocks of one pipeline see consistent write offsets).
+    """
+    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    q_pos = cache.q_positions(x.shape[1])
+    cos, sin = rope_cos_sin(q_pos, inv_freq)
+    rope = RopeAngles(inv_freq, cos, sin)
+
+    def step(carry_x, xs):
+        p, lk, lv = xs
+        out, new_k, new_v = _decoder_layer(
+            cfg, p, carry_x, lk, lv, cache, rope, q_pos, num_new, attention_fn
+        )
+        return out, (new_k, new_v)
+
+    x, (new_k, new_v) = jax.lax.scan(step, x, (layer_params, cache.k, cache.v))
+    return x, cache.replace(k=new_k, v=new_v)
+
+
+def model_apply(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    cache,
+    num_new: jnp.ndarray,
+    attention_fn=gqa_attention,
+):
+    """Full model forward: embed → layers → final norm → logits.
+
+    This is the client-side capability the reference lacks entirely (SURVEY §1:
+    "There is no client layer"). Returns ``(logits[B, S, V], cache)`` with the
+    cache advanced.
+    """
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x, cache = block_apply(cfg, params["layers"], x, cache, num_new, attention_fn)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head).astype(jnp.float32)
+    return logits, cache.advance(num_new)
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint conversion
+# ---------------------------------------------------------------------------
+
+_LAYER_KEY_MAP = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    "self_attn.o_proj.bias": ("bo", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "mlp.gate_proj.weight": ("wg", True),
+    "mlp.up_proj.weight": ("wu", True),
+    "mlp.down_proj.weight": ("wd", True),
+}
+
+
+def convert_hf_layer(
+    cfg: ModelConfig,
+    state: Mapping[str, np.ndarray],
+    layer_idx: int,
+    dtype=jnp.bfloat16,
+) -> Dict[str, np.ndarray]:
+    """Convert one HF decoder layer's tensors to our naming/layout.
+
+    ``state`` maps full HF keys (``model.layers.{i}.…``) to numpy arrays — the
+    per-layer streaming analog of the reference's
+    ``get_block_state_dict`` prefix filter
+    (``/root/reference/distributed_llm_inference/utils/model.py:40-44``).
+    """
+    prefix = f"model.layers.{layer_idx}."
+    out: Dict[str, np.ndarray] = {}
+    for suffix, (name, transpose) in _LAYER_KEY_MAP.items():
+        key = prefix + suffix
+        if key not in state:
+            continue
+        arr = np.asarray(state[key])
+        if transpose:
+            arr = arr.T
+        out[name] = arr.astype(jnp.dtype(dtype))
+    return out
+
+
+def convert_hf_state_dict(
+    cfg: ModelConfig,
+    state: Mapping[str, np.ndarray],
+    layer_ids: Optional[Sequence[int]] = None,
+    dtype=jnp.bfloat16,
+) -> Params:
+    """Convert an HF Llama/Mistral/Qwen2 state dict into our param pytree.
+
+    ``layer_ids`` selects an arbitrary list of layers (the block a node
+    serves), mirroring ``LlamaBlock(config, layer_ids)``
+    (``/root/reference/distributed_llm_inference/models/llama/model.py:17``).
+    When ``layer_ids`` is None, converts the full model including embeddings
+    and head.
+    """
+    ids: List[int] = list(layer_ids) if layer_ids is not None else list(
+        range(cfg.num_layers)
+    )
+    per_layer = [convert_hf_layer(cfg, state, i, dtype) for i in ids]
+    stacked = {
+        name: jnp.asarray(np.stack([layer[name] for layer in per_layer]))
+        for name in per_layer[0]
+    }
+    params: Params = {"layers": stacked}
+    if layer_ids is None:
+        params["embed"] = jnp.asarray(
+            np.asarray(state["model.embed_tokens.weight"]).astype(jnp.dtype(dtype))
+        )
+        params["final_norm"] = jnp.asarray(
+            np.asarray(state["model.norm.weight"]).astype(jnp.dtype(dtype))
+        )
+        if not cfg.tie_word_embeddings and "lm_head.weight" in state:
+            params["lm_head"] = jnp.asarray(
+                np.asarray(state["lm_head.weight"]).T.astype(jnp.dtype(dtype))
+            )
+    return params

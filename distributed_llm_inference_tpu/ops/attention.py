@@ -1,0 +1,99 @@
+"""Reference (XLA-fused) GQA attention and mask construction.
+
+Replaces the reference's eager attention at
+``/root/reference/distributed_llm_inference/models/llama/modules.py:87-97``:
+QK^T/sqrt(d), additive causal mask, fp32 softmax, PV. Two TPU-first changes:
+
+* No ``repeat_kv`` materialization (reference ``modules.py:87-88``): queries are
+  reshaped to ``[B, S, Hkv, G, D]`` and contracted against KV heads directly, so
+  the GQA expansion never touches HBM.
+* Masks are boolean and fused into the softmax via ``where`` rather than a
+  precomputed additive min-dtype tensor (reference ``models/llama/model.py:103-135``)
+  — XLA folds the select into the fused softmax.
+
+The Pallas flash/paged kernels in ``flash_attention.py`` / ``paged_attention.py``
+are drop-in replacements for the hot paths; this module is the always-correct
+fallback and the oracle for their tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def causal_mask(
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    kv_valid: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Boolean attend-mask ``[..., S, T]`` from per-token positions.
+
+    ``q_positions``: ``[..., S]`` absolute positions of the queries.
+    ``kv_positions``: ``[..., T]`` absolute positions of the cached keys.
+    ``kv_valid``: optional ``[..., T]`` validity of each cache slot (ring
+    buffers / padding).
+    ``sliding_window``: Mistral-style window — key visible iff
+    ``q_pos - w < k_pos <= q_pos``.
+    """
+    q = q_positions[..., :, None]
+    k = kv_positions[..., None, :]
+    mask = k <= q
+    if sliding_window is not None:
+        mask &= k > (q - sliding_window)
+    if kv_valid is not None:
+        mask &= kv_valid[..., None, :]
+    return mask
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Grouped-query attention.
+
+    ``q``: ``[B, S, Hq, D]``; ``k``/``v``: ``[B, T, Hkv, D]`` with
+    ``Hq = G * Hkv``. ``mask``: boolean ``[B, S, T]`` or ``[B, 1, S, T]``
+    (True = attend). Returns ``[B, S, Hq, D]`` in q's dtype; softmax in fp32
+    (parity with reference ``modules.py:96``).
+    """
+    b, s, hq, d = q.shape
+    t = k.shape[1]
+    hkv = k.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+
+    qg = q.reshape(b, s, hkv, g, d)
+    # [B, Hkv, G, S, T]
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+
+    if mask is not None:
+        if mask.ndim == 3:
+            m = mask[:, None, None, :, :]
+        elif mask.ndim == 4:  # [B, 1, S, T]
+            m = mask[:, :, None, :, :]
+        else:
+            raise ValueError(f"mask ndim {mask.ndim}")
+        scores = jnp.where(m, scores, _NEG_INF)
+
+    # Guard fully-masked rows (e.g. padded slots): softmax of all -inf → 0.
+    weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    if mask is not None:
+        weights = jnp.where(m, weights, 0.0)
+    denom = jnp.sum(weights, axis=-1, keepdims=True)
+    weights = weights / jnp.maximum(denom, 1e-20)
+
+    out = jnp.einsum(
+        "bkgst,btkd->bskgd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, s, hq, d).astype(q.dtype)

@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+The reference wraps HF ``LlamaRMSNorm`` modules in CUDA-graph replays for the
+decode path (``/root/reference/distributed_llm_inference/models/llama/modules.py:130-144``).
+On TPU there is nothing to capture: a jitted RMSNorm is a single fused
+XLA computation, so the whole "graphed norm" machinery collapses into this
+pure function.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm in fp32 accumulation, output cast back to input dtype."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
