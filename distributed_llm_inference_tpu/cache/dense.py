@@ -54,9 +54,29 @@ class DenseKVCache(struct.PyTreeNode):
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def layer_kv(self):
+        """Per-layer k/v stacks (leading dim = layers) for the model's scan."""
+        return self.k, self.v
+
+    def with_layer_kv(self, new_k, new_v) -> "DenseKVCache":
+        return self.replace(k=new_k, v=new_v)
+
     def q_positions(self, seq_len: int) -> jnp.ndarray:
         """Absolute positions of the incoming tokens: ``[B, S]``."""
         return self.lengths[:, None] + jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def rope_positions(self, seq_len: int, num_new: jnp.ndarray) -> jnp.ndarray:
+        """Positions at which incoming queries are rotated (= absolute here;
+        the sink cache overrides this with window-relative positions)."""
+        return self.q_positions(seq_len)
+
+    def reset_rows(self, row_mask: jnp.ndarray) -> "DenseKVCache":
+        """Zero the lengths of rows where ``row_mask`` is True (slot reuse for
+        a new session — the analog of a fresh ``generation_id``, reference
+        ``models/llama/cache.py:78-84``). Stale k/v need no clearing: validity
+        derives from ``lengths``."""
+        return self.replace(lengths=jnp.where(row_mask, 0, self.lengths))
 
     def fits(self, num_new) -> jnp.ndarray:
         """Per-row: can ``num_new`` more tokens be appended without overflow?

@@ -1,0 +1,201 @@
+"""Paged KV cache: fixed page pool + per-session page tables.
+
+The TPU-native realization of the reference's multi-tenancy goal: its
+``PartialLlamaSinkCache`` keys Python dicts of growing tensors by
+``generation_id``
+(``/root/reference/distributed_llm_inference/models/llama/cache.py:14-19``),
+which cannot live under ``jit``. Here the per-``generation_id`` state becomes
+integer indexing into a preallocated page pool (PagedAttention-style): sessions
+own rows of a ``page_table``; pages are allocated/freed host-side by the
+scheduler (``engine/scheduler.py``) and the device computation only ever sees
+static shapes.
+
+Layout:
+    ``k_pages``/``v_pages``: ``[L, num_pages, page_size, Hkv, D]`` (keys rotated)
+    ``page_table``: ``[B, max_pages_per_session]`` int32 page ids
+    ``lengths``: ``[B]`` tokens currently cached per session row
+
+Page 0 is the NULL page: never allocated to a session, absorbing writes from
+padding tokens and unallocated table slots, so a misconfigured row can never
+corrupt another session's pages.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops.attention import causal_mask
+from ..ops.rotary import RopeAngles, apply_rope
+
+
+class PagedKVCache(struct.PyTreeNode):
+    k_pages: jax.Array
+    v_pages: jax.Array
+    page_table: jax.Array
+    lengths: jax.Array
+    page_size: int = struct.field(pytree_node=False)
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        batch: int,
+        num_pages: int,
+        page_size: int,
+        max_pages_per_session: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, dtype),
+            v_pages=jnp.zeros(shape, dtype),
+            page_table=jnp.zeros((batch, max_pages_per_session), jnp.int32),
+            lengths=jnp.zeros((batch,), jnp.int32),
+            page_size=page_size,
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+    @property
+    def layer_kv(self):
+        return self.k_pages, self.v_pages
+
+    def with_layer_kv(self, new_k, new_v) -> "PagedKVCache":
+        return self.replace(k_pages=new_k, v_pages=new_v)
+
+    def q_positions(self, seq_len: int) -> jnp.ndarray:
+        return self.lengths[:, None] + jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def rope_positions(self, seq_len: int, num_new: jnp.ndarray) -> jnp.ndarray:
+        return self.q_positions(seq_len)
+
+    def fits(self, num_new) -> jnp.ndarray:
+        """Scheduler contract as in ``DenseKVCache.fits`` — additionally the
+        scheduler must have mapped enough pages in ``page_table``."""
+        return self.lengths + num_new <= self.max_len
+
+    def update_and_gather(
+        self,
+        layer_k: jnp.ndarray,
+        layer_v: jnp.ndarray,
+        q: jnp.ndarray,
+        k_new: jnp.ndarray,
+        v_new: jnp.ndarray,
+        rope: RopeAngles,
+        q_pos: jnp.ndarray,
+        num_new: jnp.ndarray,
+        sliding_window: Optional[int] = None,
+    ) -> Tuple[jnp.ndarray, ...]:
+        """Scatter new k/v into pages; gather each row's pages for attention.
+
+        ``layer_k``/``layer_v``: ``[P, page_size, Hkv, D]`` (one layer).
+        The gather materializes ``[B, max_pages_per_session * page_size, …]``
+        per layer — the XLA-fused correctness baseline. The Pallas paged
+        kernel (``ops/paged_attention.py``) reads pages in place instead.
+        """
+        b, s, hkv, d = k_new.shape
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+
+        # Map each incoming token's absolute position → (physical page, offset).
+        table_slot = q_pos // self.page_size  # [B, S]
+        offset = q_pos % self.page_size
+        in_range = (
+            jnp.arange(s, dtype=jnp.int32)[None, :] < num_new[:, None]
+        ) & (table_slot < self.page_table.shape[1])
+        phys_page = jnp.take_along_axis(
+            self.page_table, jnp.minimum(table_slot, self.page_table.shape[1] - 1),
+            axis=1,
+        )
+        # Padding / out-of-range tokens are routed to the null page 0.
+        phys_page = jnp.where(in_range, phys_page, 0)
+
+        flat_page = phys_page.reshape(-1)
+        flat_off = offset.reshape(-1)
+        new_k = layer_k.at[flat_page, flat_off].set(
+            k_rot.reshape(b * s, hkv, d), mode="drop"
+        )
+        new_v = layer_v.at[flat_page, flat_off].set(
+            v_new.reshape(b * s, hkv, d), mode="drop"
+        )
+
+        # Gather this row's pages into a contiguous view. Slot i of the view
+        # holds absolute position i because table slots are position-ordered.
+        k_all = jnp.take(new_k, self.page_table, axis=0).reshape(
+            b, self.max_len, hkv, d
+        )
+        v_all = jnp.take(new_v, self.page_table, axis=0).reshape(
+            b, self.max_len, hkv, d
+        )
+
+        kv_pos = jnp.broadcast_to(
+            jnp.arange(self.max_len, dtype=jnp.int32)[None, :], (b, self.max_len)
+        )
+        kv_valid = kv_pos < (self.lengths + num_new)[:, None]
+        mask = causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
+        return q_rot, k_all, v_all, mask, new_k, new_v
+
+    def advance(self, num_new: jnp.ndarray) -> "PagedKVCache":
+        return self.replace(lengths=self.lengths + num_new)
+
+    def reset_rows(self, row_mask: jnp.ndarray) -> "PagedKVCache":
+        """Clear sessions (host frees their pages via the allocator)."""
+        return self.replace(
+            lengths=jnp.where(row_mask, 0, self.lengths),
+            page_table=jnp.where(row_mask[:, None], 0, self.page_table),
+        )
+
+    def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
+        """Host-side helper: install allocator-chosen page ids for a row."""
+        pages = jnp.asarray(pages, jnp.int32)
+        return self.replace(
+            page_table=jax.lax.dynamic_update_slice(
+                self.page_table, pages[None, :], (row, start_slot)
+            )
+        )
+
+
+class PageAllocator:
+    """Host-side free-list page allocator (page 0 reserved as the null page).
+
+    Plays the role hivemind's runtime state played for the reference's server:
+    pure Python, not traced — only its *outputs* (page tables) reach the
+    device. Guarded by the engine's scheduler lock (SURVEY §5.2).
+    """
+
+    def __init__(self, num_pages: int):
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields low ids first
+        self._free_set = set(self._free)
+        self.num_pages = num_pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int):
+        if n > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: want {n}, have {len(self._free)}"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(pages)
+        return pages
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(
+                    f"page {p} outside pool (1..{self.num_pages - 1}; 0 is the "
+                    "reserved null page)"
+                )
+            if p in self._free_set:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+            self._free_set.add(p)

@@ -1,0 +1,183 @@
+"""Sink (StreamingLLM) KV cache as a static ring buffer.
+
+Capability-parity redesign of the reference's signature feature,
+``PartialLlamaSinkCache``
+(``/root/reference/distributed_llm_inference/models/llama/cache.py:7-135``):
+``num_sink_tokens`` attention sinks plus a sliding window of the most recent
+tokens, giving constant memory over unbounded streams, with keys positioned
+*window-relatively* so RoPE never sees unbounded positions.
+
+The reference implements eviction by slicing the kept keys out, re-rotating
+them by the accumulated shift (``cache.py:111-133``, rerotation matrices cached
+at ``:21-48``), and ``torch.cat``-ing — data movement plus compounding float
+error from composed rotations. The TPU-native design inverts it:
+
+* Keys are stored **unrotated** in a fixed ``[window]`` ring buffer; nothing
+  ever moves on eviction — a new token simply overwrites the ring slot of the
+  evicted one.
+* At attention time each live slot's *effective position* (sinks at
+  ``0..s-1``, window tokens at ``s..W-1``, query on top) is computed from
+  ``seen`` by modular arithmetic, and keys are rotated directly to those
+  angles — one fused elementwise op over data attention reads anyway, and a
+  single rotation instead of the reference's rotation-composition chain.
+
+Eviction granularity is the update chunk: positions are framed by the
+post-update stream length, exact for token-by-token decode (the StreamingLLM
+regime). The engine keeps prefill chunks ≤ ``window - sinks`` (scheduler
+contract, as with ``DenseKVCache.fits``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops.attention import causal_mask
+from ..ops.rotary import RopeAngles, apply_rope, rope_cos_sin
+
+
+class SinkKVCache(struct.PyTreeNode):
+    """``k`` (unrotated)/``v``: ``[L, B, W, Hkv, D]``; ``seen``: ``[B]`` total
+    stream length per session row."""
+
+    k: jax.Array
+    v: jax.Array
+    seen: jax.Array
+    num_sinks: int = struct.field(pytree_node=False)
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        batch: int,
+        window_length: int,
+        num_sink_tokens: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "SinkKVCache":
+        if not 0 <= num_sink_tokens < window_length:
+            raise ValueError("need 0 <= num_sink_tokens < window_length")
+        shape = (num_layers, batch, window_length, num_kv_heads, head_dim)
+        return SinkKVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            seen=jnp.zeros((batch,), jnp.int32),
+            num_sinks=num_sink_tokens,
+        )
+
+    @property
+    def window(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def layer_kv(self):
+        return self.k, self.v
+
+    def with_layer_kv(self, new_k, new_v) -> "SinkKVCache":
+        return self.replace(k=new_k, v=new_v)
+
+    # -- position bookkeeping -------------------------------------------------
+
+    def _slot_of(self, pos: jnp.ndarray) -> jnp.ndarray:
+        """Ring slot of the token with absolute stream position ``pos``."""
+        s, w = self.num_sinks, self.window
+        return jnp.where(pos < s, pos, s + (pos - s) % (w - s))
+
+    def _slot_positions(self, total: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Absolute position held by each ring slot after ``total`` tokens.
+
+        Returns ``(pos[B, W], valid[B, W])``; the latest write wins a slot.
+        """
+        s, w = self.num_sinks, self.window
+        slot = jnp.arange(w, dtype=jnp.int32)[None, :]
+        n = total[:, None]
+        # Non-sink slot j (rel = j - s) holds p = s + rel + m*(w-s) for the
+        # largest m with p < n.
+        rel = slot - s
+        m = (n - 1 - s - rel) // (w - s)
+        pos_ring = s + rel + jnp.maximum(m, 0) * (w - s)
+        pos = jnp.where(slot < s, slot, pos_ring)
+        valid = pos < n
+        return pos, valid
+
+    def _effective(self, pos: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+        """Window-relative position used for rotation: sinks keep 0..s-1; the
+        oldest surviving window token sits at s (reference semantics — after
+        eviction the kept keys are re-rotated to close ranks, ``cache.py:116-124``)."""
+        s, w = self.num_sinks, self.window
+        oldest = jnp.maximum(s, total - (w - s))
+        if pos.ndim == 2 and total.ndim == 1:
+            oldest = oldest[:, None]
+        return jnp.where(pos < s, pos, s + pos - oldest)
+
+    # -- cache interface ------------------------------------------------------
+
+    def q_positions(self, seq_len: int) -> jnp.ndarray:
+        """Absolute stream positions of incoming tokens (used for causal
+        masking, which stays exact under eviction)."""
+        return self.seen[:, None] + jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def rope_positions(self, seq_len: int, num_new: jnp.ndarray) -> jnp.ndarray:
+        """Window-relative positions at which queries are rotated."""
+        total = self.seen + num_new
+        return self._effective(self.q_positions(seq_len), total)
+
+    def fits(self, num_new) -> jnp.ndarray:
+        """A sink cache never overflows — chunks just must not exceed the
+        ring's non-sink span (engine contract)."""
+        return jnp.broadcast_to(
+            jnp.asarray(num_new) <= self.window - self.num_sinks, self.seen.shape
+        )
+
+    def update_and_gather(
+        self,
+        layer_k: jnp.ndarray,
+        layer_v: jnp.ndarray,
+        q: jnp.ndarray,
+        k_new: jnp.ndarray,
+        v_new: jnp.ndarray,
+        rope: RopeAngles,
+        q_pos: jnp.ndarray,
+        num_new: jnp.ndarray,
+        sliding_window: Optional[int] = None,
+    ) -> Tuple[jnp.ndarray, ...]:
+        """Write unrotated k/v into ring slots; rotate live keys to their
+        effective positions; build the exact causal+liveness mask.
+
+        ``layer_k``/``layer_v``: ``[B, W, Hkv, D]``. ``sliding_window`` is
+        ignored — the ring *is* the window policy.
+        """
+        b, s_len = q.shape[:2]
+        total = self.seen + num_new
+
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+
+        slots = self._slot_of(q_pos)  # [B, S]
+        in_chunk = jnp.arange(s_len, dtype=jnp.int32)[None, :] < num_new[:, None]
+        # Padding tokens must not clobber live slots: divert them out of
+        # bounds, where scatter mode="drop" discards the write.
+        slots = jnp.where(in_chunk, slots, self.window)
+
+        def write_row(buf, vals, idx):
+            return buf.at[idx].set(vals, mode="drop")
+
+        new_k = jax.vmap(write_row)(layer_k, k_new, slots)
+        new_v = jax.vmap(write_row)(layer_v, v_new, slots)
+
+        kv_pos, kv_live = self._slot_positions(total)
+        eff = self._effective(kv_pos, total)
+        cos_k, sin_k = rope_cos_sin(eff, rope.inv_freq)
+        k_eff = apply_rope(new_k, cos_k, sin_k)
+
+        # Causal on absolute positions; liveness excludes evicted/empty slots.
+        mask = causal_mask(q_pos, kv_pos, kv_live)
+        return q_rot, k_eff, new_v, mask, new_k, new_v
+
+    def advance(self, num_new: jnp.ndarray) -> "SinkKVCache":
+        return self.replace(seen=self.seen + num_new)
+
+    def reset_rows(self, row_mask: jnp.ndarray) -> "SinkKVCache":
+        return self.replace(seen=jnp.where(row_mask, 0, self.seen))

@@ -169,7 +169,8 @@ def block_apply(
     """
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     q_pos = cache.q_positions(x.shape[1])
-    cos, sin = rope_cos_sin(q_pos, inv_freq)
+    rot_pos = cache.rope_positions(x.shape[1], num_new)
+    cos, sin = rope_cos_sin(rot_pos, inv_freq)
     rope = RopeAngles(inv_freq, cos, sin)
 
     def step(carry_x, xs):
@@ -179,8 +180,9 @@ def block_apply(
         )
         return out, (new_k, new_v)
 
-    x, (new_k, new_v) = jax.lax.scan(step, x, (layer_params, cache.k, cache.v))
-    return x, cache.replace(k=new_k, v=new_v)
+    lk, lv = cache.layer_kv
+    x, (new_k, new_v) = jax.lax.scan(step, x, (layer_params, lk, lv))
+    return x, cache.with_layer_kv(new_k, new_v)
 
 
 def model_apply(

@@ -2,20 +2,32 @@
 
 Multi-device tests exercise mesh sharding, ppermute pipelines, and collective
 correctness without a real pod (SURVEY §4's test strategy): XLA's host
-platform is split into 8 virtual devices. Must run before the first jax
-import.
+platform is split into 8 virtual devices.
+
+NOTE: this environment pre-imports jax at interpreter startup (sitecustomize
+registers the axon TPU plugin), so setting ``JAX_PLATFORMS`` via ``os.environ``
+here is too late — jax's config already captured the env. ``jax.config.update``
+works post-import, and ``XLA_FLAGS`` is still honored because the CPU client
+is created lazily at first use. Without this, tests silently run on the single
+tunneled TPU chip and deadlock when two processes contend for it.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_sessionstart(session):
+    assert jax.default_backend() == "cpu", (
+        "tests must run on the virtual CPU platform, not the tunneled TPU"
+    )
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"

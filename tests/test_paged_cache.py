@@ -1,0 +1,108 @@
+"""Paged cache correctness: must be semantically identical to the dense cache
+(same tokens in → same logits out), plus allocator invariants."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_llm_inference_tpu.cache.dense import DenseKVCache
+from distributed_llm_inference_tpu.cache.paged import PagedKVCache, PageAllocator
+from distributed_llm_inference_tpu.config import ModelConfig
+from distributed_llm_inference_tpu.models import llama
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+
+
+def _paged(batch, alloc_rows):
+    cache = PagedKVCache.create(
+        CFG.num_layers, batch, num_pages=32, page_size=4,
+        max_pages_per_session=8, num_kv_heads=CFG.num_kv_heads,
+        head_dim=CFG.head_dim, dtype=jnp.float32,
+    )
+    allocator = PageAllocator(32)
+    for row, n_pages in alloc_rows:
+        cache = cache.assign_pages(row, allocator.alloc(n_pages))
+    return cache, allocator
+
+
+def test_paged_matches_dense_prefill_and_decode():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, CFG.vocab_size)
+
+    dense = DenseKVCache.create(
+        CFG.num_layers, 2, 32, CFG.num_kv_heads, CFG.head_dim, dtype=jnp.float32
+    )
+    paged, _ = _paged(2, [(0, 8), (1, 8)])
+
+    num_new = jnp.asarray([9, 6], jnp.int32)  # ragged rows
+    ld, dense = llama.model_apply(CFG, params, tokens, dense, num_new)
+    lp, paged = llama.model_apply(CFG, params, tokens, paged, num_new)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(ld), atol=1e-5, rtol=1e-5)
+
+    one = jnp.ones((2,), jnp.int32)
+    for i in range(5):
+        t = tokens[:, i : i + 1]
+        ld, dense = llama.model_apply(CFG, params, t, dense, one)
+        lp, paged = llama.model_apply(CFG, params, t, paged, one)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(ld), atol=1e-5, rtol=1e-5)
+
+
+def test_padding_tokens_cannot_corrupt_other_sessions():
+    """Row 1 has no pages mapped beyond its range; its padding writes must land
+    on the null page, leaving row 0's data intact."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, CFG.vocab_size)
+
+    paged, _ = _paged(2, [(0, 2), (1, 2)])  # 8-token capacity each
+    num_new = jnp.asarray([8, 3], jnp.int32)  # row 1: 5 padding tokens
+    l_joint, paged = llama.model_apply(CFG, params, tokens, paged, num_new)
+
+    # Row 0 in the shared pool must match a solo run of row 0 (tolerance is
+    # fp32 epsilon: XLA fusion order differs with batch size; corruption from
+    # a stray write would be O(1), not 1e-7).
+    solo, _ = _paged(1, [(0, 2)])
+    l_solo, solo = llama.model_apply(
+        CFG, params, tokens[:1], solo, jnp.asarray([8], jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(l_joint[0]), np.asarray(l_solo[0]), atol=1e-5, rtol=1e-5
+    )
+
+    # …including a subsequent decode step from the shared cache.
+    one = jnp.ones((1,), jnp.int32)
+    nxt = tokens[:1, :1]
+    l_d_joint, _ = llama.model_apply(
+        CFG, params, jnp.concatenate([nxt, nxt], 0), paged, jnp.ones((2,), jnp.int32)
+    )
+    l_d_solo, _ = llama.model_apply(CFG, params, nxt, solo, one)
+    np.testing.assert_allclose(
+        np.asarray(l_d_joint[0]), np.asarray(l_d_solo[0]), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_reset_rows_frees_session_state():
+    paged, _ = _paged(2, [(0, 4), (1, 4)])
+    paged = paged.advance(jnp.asarray([5, 7], jnp.int32))
+    paged = paged.reset_rows(jnp.asarray([True, False]))
+    assert paged.lengths.tolist() == [0, 7]
+    assert paged.page_table[0].tolist() == [0] * 8
+    assert paged.page_table[1].tolist() != [0] * 8
+
+
+def test_allocator_invariants():
+    a = PageAllocator(8)
+    pages = a.alloc(7)
+    assert 0 not in pages and sorted(pages) == list(range(1, 8))
+    with pytest.raises(MemoryError):
+        a.alloc(1)
+    a.free(pages[:3])
+    assert a.free_count == 3
+    with pytest.raises(ValueError):
+        a.free([pages[0]])  # double free
+    with pytest.raises(ValueError):
+        a.free([0])  # null page

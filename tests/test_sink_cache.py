@@ -1,0 +1,126 @@
+"""Sink (StreamingLLM) cache vs an independent list-based oracle.
+
+SURVEY §7 "Hard parts": "Re-rotation correctness … property-test against a
+recompute-from-scratch oracle." The oracle below maintains an explicit Python
+list of kept (position, k, v) triples with the reference's eviction rule
+(keep ``num_sinks`` sinks + the window tail —
+``/root/reference/distributed_llm_inference/models/llama/cache.py:111-133``)
+and recomputes attention from scratch each step, rotating every key directly
+at its index-in-cache. The ring-buffer implementation must match it bitwise-ish
+(fp32 tolerance) across eviction wrap-arounds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_inference_tpu.cache.dense import DenseKVCache
+from distributed_llm_inference_tpu.cache.sink import SinkKVCache
+from distributed_llm_inference_tpu.config import ModelConfig
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.ops.attention import gqa_attention
+from distributed_llm_inference_tpu.ops.rotary import (
+    RopeAngles,
+    apply_rope,
+    rope_cos_sin,
+    rope_inv_freq,
+)
+
+HKV, HQ, D = 2, 4, 16
+W, S = 8, 2  # window, sinks
+
+
+def oracle_decode_step(kept, q, k_new, v_new, inv_freq):
+    """kept: list of (k, v) in cache order (sinks first, then chronological).
+    Appends the new token, evicts the oldest non-sink if over the window,
+    rotates key i at position i and the query at len-1, runs full attention."""
+    kept.append((k_new, v_new))
+    if len(kept) > W:
+        del kept[S]
+    idx = jnp.arange(len(kept), dtype=jnp.int32)[None, :]
+    cos, sin = rope_cos_sin(idx, inv_freq)
+    ks = jnp.stack([k for k, _ in kept], axis=0)[None]  # [1, T, HKV, D]
+    vs = jnp.stack([v for _, v in kept], axis=0)[None]
+    ks = apply_rope(ks, cos, sin)
+    qcos, qsin = rope_cos_sin(
+        jnp.asarray([[len(kept) - 1]], jnp.int32), inv_freq
+    )
+    q_rot = apply_rope(q[None, None], qcos, qsin)  # [1, 1, HQ, D]
+    return gqa_attention(q_rot, ks, vs)[0, 0]
+
+
+def test_sink_attention_matches_oracle_through_wraparound():
+    rng = jax.random.PRNGKey(0)
+    inv_freq = rope_inv_freq(D, 10000.0)
+    steps = 25  # > 3x window → several wrap-arounds
+
+    cache = SinkKVCache.create(1, 1, W, S, HKV, D, dtype=jnp.float32)
+    kept = []
+    for t in range(steps):
+        rng, kq, kk, kv = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (HQ, D), jnp.float32)
+        k = jax.random.normal(kk, (HKV, D), jnp.float32)
+        v = jax.random.normal(kv, (HKV, D), jnp.float32)
+
+        num_new = jnp.ones((1,), jnp.int32)
+        q_pos = cache.q_positions(1)
+        rot_pos = cache.rope_positions(1, num_new)
+        cos, sin = rope_cos_sin(rot_pos, inv_freq)
+        rope = RopeAngles(inv_freq, cos, sin)
+        q_rot, k_eff, v_all, mask, new_k, new_v = cache.update_and_gather(
+            cache.k[0], cache.v[0], q[None, None], k[None, None], v[None, None],
+            rope, q_pos, num_new,
+        )
+        out = gqa_attention(q_rot, k_eff, v_all, mask)[0, 0]
+        cache = cache.replace(k=new_k[None], v=new_v[None]).advance(num_new)
+
+        expected = oracle_decode_step(kept, q, k, v, inv_freq)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), atol=1e-5, rtol=1e-5,
+            err_msg=f"step {t}",
+        )
+
+
+def test_sink_matches_dense_before_eviction():
+    """With the stream shorter than the window, sink == dense exactly."""
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=96, num_layers=2,
+        num_heads=HQ, num_kv_heads=HKV, head_dim=D // 2,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0, cfg.vocab_size)
+
+    dense = DenseKVCache.create(2, 2, 16, HKV, D // 2, dtype=jnp.float32)
+    sink = SinkKVCache.create(2, 2, 16, 2, HKV, D // 2, dtype=jnp.float32)
+
+    num_new = jnp.asarray([6, 4], jnp.int32)
+    ld, dense = llama.model_apply(cfg, params, tokens, dense, num_new)
+    ls, sink = llama.model_apply(cfg, params, tokens, sink, num_new)
+    np.testing.assert_allclose(np.asarray(ls), np.asarray(ld), atol=1e-5, rtol=1e-5)
+
+    one = jnp.ones((2,), jnp.int32)
+    for i in range(4):
+        t = tokens[:, i : i + 1]
+        ld, dense = llama.model_apply(cfg, params, t, dense, one)
+        ls, sink = llama.model_apply(cfg, params, t, sink, one)
+        np.testing.assert_allclose(
+            np.asarray(ls), np.asarray(ld), atol=1e-5, rtol=1e-5
+        )
+
+
+def test_sink_unbounded_stream_stays_finite():
+    """Decode far past the window: constant memory, finite outputs,
+    multi-row independence (different stream lengths per row)."""
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=96, num_layers=2,
+        num_heads=HQ, num_kv_heads=HKV, head_dim=D // 2,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    sink = SinkKVCache.create(2, 2, W, S, HKV, D // 2, dtype=jnp.float32)
+
+    tok = jnp.asarray([[1], [2]])
+    for t in range(3 * W):
+        num_new = jnp.asarray([1, 1 if t % 2 == 0 else 0], jnp.int32)
+        logits, sink = llama.model_apply(cfg, params, tok, sink, num_new)
+        assert bool(jnp.all(jnp.isfinite(logits)))
+    assert sink.seen.tolist() == [3 * W, 3 * W // 2]
