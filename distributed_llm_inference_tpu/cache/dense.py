@@ -78,6 +78,25 @@ class DenseKVCache(struct.PyTreeNode):
         derives from ``lengths``."""
         return self.replace(lengths=jnp.where(row_mask, 0, self.lengths))
 
+    def select_row(self, row) -> "DenseKVCache":
+        """Batch-1 view of one session row (jit-safe, ``row`` may be traced).
+        Used by the engine to prefill a newly admitted session without
+        touching (or recomputing over) the other rows."""
+        return self.replace(
+            k=jax.lax.dynamic_slice_in_dim(self.k, row, 1, axis=1),
+            v=jax.lax.dynamic_slice_in_dim(self.v, row, 1, axis=1),
+            lengths=jax.lax.dynamic_slice_in_dim(self.lengths, row, 1),
+        )
+
+    def merge_row(self, sub: "DenseKVCache", row) -> "DenseKVCache":
+        return self.replace(
+            k=jax.lax.dynamic_update_slice_in_dim(self.k, sub.k, row, axis=1),
+            v=jax.lax.dynamic_update_slice_in_dim(self.v, sub.v, row, axis=1),
+            lengths=jax.lax.dynamic_update_slice_in_dim(
+                self.lengths, sub.lengths, row, axis=0
+            ),
+        )
+
     def fits(self, num_new) -> jnp.ndarray:
         """Per-row: can ``num_new`` more tokens be appended without overflow?
 

@@ -152,6 +152,26 @@ class PagedKVCache(struct.PyTreeNode):
             page_table=jnp.where(row_mask[:, None], 0, self.page_table),
         )
 
+    def select_row(self, row) -> "PagedKVCache":
+        """Batch-1 view: row-local page table/length over the SHARED page
+        pool, so a single-row prefill writes straight into the pool."""
+        return self.replace(
+            page_table=jax.lax.dynamic_slice_in_dim(self.page_table, row, 1, axis=0),
+            lengths=jax.lax.dynamic_slice_in_dim(self.lengths, row, 1),
+        )
+
+    def merge_row(self, sub: "PagedKVCache", row) -> "PagedKVCache":
+        return self.replace(
+            k_pages=sub.k_pages,
+            v_pages=sub.v_pages,
+            page_table=jax.lax.dynamic_update_slice_in_dim(
+                self.page_table, sub.page_table, row, axis=0
+            ),
+            lengths=jax.lax.dynamic_update_slice_in_dim(
+                self.lengths, sub.lengths, row, axis=0
+            ),
+        )
+
     def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
         """Host-side helper: install allocator-chosen page ids for a row."""
         pages = jnp.asarray(pages, jnp.int32)

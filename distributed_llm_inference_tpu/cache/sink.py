@@ -181,3 +181,17 @@ class SinkKVCache(struct.PyTreeNode):
 
     def reset_rows(self, row_mask: jnp.ndarray) -> "SinkKVCache":
         return self.replace(seen=jnp.where(row_mask, 0, self.seen))
+
+    def select_row(self, row) -> "SinkKVCache":
+        return self.replace(
+            k=jax.lax.dynamic_slice_in_dim(self.k, row, 1, axis=1),
+            v=jax.lax.dynamic_slice_in_dim(self.v, row, 1, axis=1),
+            seen=jax.lax.dynamic_slice_in_dim(self.seen, row, 1),
+        )
+
+    def merge_row(self, sub: "SinkKVCache", row) -> "SinkKVCache":
+        return self.replace(
+            k=jax.lax.dynamic_update_slice_in_dim(self.k, sub.k, row, axis=1),
+            v=jax.lax.dynamic_update_slice_in_dim(self.v, sub.v, row, axis=1),
+            seen=jax.lax.dynamic_update_slice_in_dim(self.seen, sub.seen, row, axis=0),
+        )

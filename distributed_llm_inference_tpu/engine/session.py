@@ -1,0 +1,64 @@
+"""Host-side session bookkeeping.
+
+A session is one generation stream — the durable identity behind the
+reference's ``generation_id`` threading
+(``/root/reference/distributed_llm_inference/models/llama/model.py:27`` →
+``modules.py:39`` → ``cache.py:74``). Device state is integer-slot-indexed
+(batch row, page table); everything string-keyed lives here on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import time
+from typing import List, Optional
+
+from .sampling import SamplingOptions
+
+_ids = itertools.count()
+
+
+class SessionState(enum.Enum):
+    WAITING = "waiting"
+    ACTIVE = "active"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class Session:
+    prompt: List[int]
+    options: SamplingOptions
+    generation_id: str = dataclasses.field(
+        default_factory=lambda: f"gen-{next(_ids)}"
+    )
+    state: SessionState = SessionState.WAITING
+    slot: Optional[int] = None
+    pages: List[int] = dataclasses.field(default_factory=list)
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None  # "eos" | "length" | "capacity" | "cancelled"
+    # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
+    submit_time: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1] if self.generated else self.prompt[-1]
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.generated)
+
+    def record_token(self, token: int) -> None:
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        self.generated.append(token)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time

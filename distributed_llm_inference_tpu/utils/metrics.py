@@ -1,0 +1,72 @@
+"""Structured metrics and timing.
+
+The reference's only observability is two ``print`` statements in its weight
+loader (``/root/reference/distributed_llm_inference/utils/model.py:61,82``;
+SURVEY §5.5). Here: counters + latency histograms good enough to derive the
+BASELINE metrics (tokens/sec/chip, p50 TTFT, batch occupancy) plus structured
+logging hooks.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import logging
+import statistics
+import threading
+import time
+from typing import Dict, List
+
+logger = logging.getLogger("distributed_llm_inference_tpu")
+
+
+class Metrics:
+    """Thread-safe counters and timers (the serving loop runs host threads
+    around the jitted steps — SURVEY §5.2's concurrency caution)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = collections.defaultdict(float)
+        self._timings: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def counter(self, name: str, inc: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += inc
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._timings[name].append(time.perf_counter() - t0)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._timings[name].append(value)
+
+    def percentile(self, name: str, q: float) -> float:
+        with self._lock:
+            vals = sorted(self._timings.get(name, []))
+        if not vals:
+            return float("nan")
+        idx = min(len(vals) - 1, int(q / 100.0 * len(vals)))
+        return vals[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            for name, vals in self._timings.items():
+                if not vals:
+                    continue
+                out[f"{name}_count"] = len(vals)
+                out[f"{name}_mean_s"] = statistics.fmean(vals)
+                srt = sorted(vals)
+                out[f"{name}_p50_s"] = srt[len(srt) // 2]
+                out[f"{name}_p99_s"] = srt[min(len(srt) - 1, int(0.99 * len(srt)))]
+        return out
+
+    def log_snapshot(self) -> None:
+        logger.info("metrics %s", json.dumps(self.snapshot(), sort_keys=True))
