@@ -1,0 +1,19 @@
+from .mesh import build_mesh, named_sharding, single_device_mesh
+from .tp import (
+    cache_pspecs,
+    layer_pspecs,
+    param_pspecs,
+    shard_pytree,
+    validate_tp,
+)
+
+__all__ = [
+    "build_mesh",
+    "named_sharding",
+    "single_device_mesh",
+    "cache_pspecs",
+    "layer_pspecs",
+    "param_pspecs",
+    "shard_pytree",
+    "validate_tp",
+]

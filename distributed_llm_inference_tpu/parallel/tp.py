@@ -1,0 +1,147 @@
+"""Tensor-parallel sharding rules (Megatron-style, GSPMD-compiled).
+
+The reference's "tensor parallelism" is the vestigial HF ``pretraining_tp``
+path: slicing q/k/v/o weights on ONE device and summing partial ``F.linear``
+results (``/root/reference/distributed_llm_inference/models/llama/modules.py:
+44-59,107-110``) — no collectives, no process groups. Here TP is real and
+declarative: parameters get ``NamedSharding`` annotations over the ``tp`` mesh
+axis and XLA's SPMD partitioner inserts the all-reduces (as ICI collectives)
+that Megatron would issue via NCCL:
+
+* column-parallel: ``wq/wk/wv`` (head dim), ``wg/wu`` (MLP features) — each
+  device computes its heads/features, no communication;
+* row-parallel: ``wo``, ``wd`` (contracting dim sharded) — XLA inserts the
+  ``psum`` over ``tp`` after the matmul;
+* KV cache heads are sharded over ``tp`` so cache reads/writes stay local;
+* embedding is vocab-sharded (gather crosses ``tp`` once per step);
+  ``lm_head`` shards the logits' vocab dim (argmax/top-k run sharded).
+
+No model code changes: the same ``model_apply`` runs on 1 device or a pod —
+only the shardings of its inputs differ.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+
+__all__ = [
+    "layer_pspecs",
+    "param_pspecs",
+    "cache_pspecs",
+    "shard_pytree",
+    "validate_tp",
+]
+
+# Stacked per-layer parameters: leading axis is the layer stack. ``pp`` shards
+# that axis when pipelining (parallel/pipeline.py); None here (pure TP).
+_LAYER_RULES: Dict[str, P] = {
+    "attn_norm": P("pp", None),
+    "wq": P("pp", None, "tp"),
+    "wk": P("pp", None, "tp"),
+    "wv": P("pp", None, "tp"),
+    "bq": P("pp", "tp"),
+    "bk": P("pp", "tp"),
+    "bv": P("pp", "tp"),
+    "wo": P("pp", "tp", None),
+    "bo": P("pp", None),
+    "mlp_norm": P("pp", None),
+    "wg": P("pp", None, "tp"),
+    "wu": P("pp", None, "tp"),
+    "wd": P("pp", "tp", None),
+    # MoE (Mixtral): experts axis [L, E, in, out] — experts replicated across
+    # tp, features sharded like the dense MLP; router replicated.
+    "router": P("pp", None, None),
+    "we_g": P("pp", None, None, "tp"),
+    "we_u": P("pp", None, None, "tp"),
+    "we_d": P("pp", None, "tp", None),
+}
+
+
+def _strip_pp(spec: P, use_pp: bool) -> P:
+    if use_pp:
+        return spec
+    return P(None, *spec[1:])
+
+
+def layer_pspecs(use_pp: bool = False) -> Dict[str, P]:
+    """PartitionSpecs for the stacked layer-param dict.
+
+    ``use_pp=True`` additionally shards the leading layer-stack axis over the
+    ``pp`` mesh axis (each pipeline stage holds its contiguous slice of
+    layers — the mesh-native form of the reference's per-node layer blocks,
+    ``server/worker.py:13-14``).
+    """
+    return {k: _strip_pp(v, use_pp) for k, v in _LAYER_RULES.items()}
+
+
+def param_pspecs(params: Dict[str, Any], use_pp: bool = False) -> Dict[str, Any]:
+    """Spec pytree matching a full or block-only param pytree."""
+    lp = layer_pspecs(use_pp)
+    out: Dict[str, Any] = {}
+    if "layers" in params:
+        out["layers"] = {k: lp[k] for k in params["layers"]}
+    if "embed" in params:
+        out["embed"] = P("tp", None)
+    if "final_norm" in params:
+        out["final_norm"] = P(None)
+    if "lm_head" in params:
+        out["lm_head"] = P(None, "tp")
+    return out
+
+
+def cache_pspecs(cache: Any, use_pp: bool = False) -> Any:
+    """Spec pytree for a KV cache (dense/paged/sink).
+
+    KV heads shard over ``tp`` (reads/writes stay device-local); batch rows
+    over ``dp``; the layer axis over ``pp`` when pipelining.
+    """
+    from ..cache.dense import DenseKVCache
+    from ..cache.paged import PagedKVCache
+    from ..cache.sink import SinkKVCache
+
+    pp = "pp" if use_pp else None
+    if isinstance(cache, DenseKVCache):
+        kv = P(pp, "dp", None, "tp", None)
+        return DenseKVCache(k=kv, v=kv, lengths=P("dp"))
+    if isinstance(cache, PagedKVCache):
+        kv = P(pp, None, None, "tp", None)
+        return PagedKVCache(
+            k_pages=kv, v_pages=kv, page_table=P("dp", None), lengths=P("dp"),
+            page_size=cache.page_size,
+        )
+    if isinstance(cache, SinkKVCache):
+        kv = P(pp, "dp", None, "tp", None)
+        return SinkKVCache(k=kv, v=kv, seen=P("dp"), num_sinks=cache.num_sinks)
+    raise TypeError(f"unknown cache type {type(cache)}")
+
+
+def shard_pytree(tree: Any, mesh: Mesh, specs: Any) -> Any:
+    """``device_put`` every leaf with its NamedSharding (host → mesh)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def validate_tp(cfg: ModelConfig, tp: int, sp: int = 1) -> None:
+    """Fail fast on invalid degree combinations (divisibility constraints)."""
+    if cfg.num_kv_heads % tp != 0:
+        raise ValueError(
+            f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
+            "(KV heads are sharded over tp)"
+        )
+    if cfg.intermediate_size % tp != 0:
+        raise ValueError(
+            f"tp={tp} must divide intermediate_size={cfg.intermediate_size}"
+        )
+    if cfg.vocab_size % tp != 0:
+        raise ValueError(f"tp={tp} must divide vocab_size={cfg.vocab_size}")
+    if sp > 1 and cfg.num_heads % sp != 0:
+        raise ValueError(
+            f"sp={sp} must divide num_heads={cfg.num_heads} (ring attention "
+            "all-to-alls heads across sp)"
+        )
