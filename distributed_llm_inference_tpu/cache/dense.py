@@ -34,6 +34,11 @@ class DenseKVCache(struct.PyTreeNode):
     v: jax.Array
     lengths: jax.Array
 
+    # Declarative layout for generic consumers (pipeline row slicing, pp
+    # sharding specs): field → batch axis; fields with a leading layer axis.
+    BATCH_AXES = {"k": 1, "v": 1, "lengths": 0}
+    LAYER_FIELDS = ("k", "v")
+
     @staticmethod
     def create(
         num_layers: int,

@@ -39,6 +39,13 @@ class PagedKVCache(struct.PyTreeNode):
     lengths: jax.Array
     page_size: int = struct.field(pytree_node=False)
 
+    # Generic-consumer layout (see DenseKVCache): the page pool is batch-free;
+    # only the table/lengths have session rows. Pool fields carry the layer
+    # axis and are passed through whole on row slices (SHARED_FIELDS).
+    BATCH_AXES = {"page_table": 0, "lengths": 0}
+    LAYER_FIELDS = ("k_pages", "v_pages")
+    SHARED_FIELDS = ("k_pages", "v_pages")
+
     @staticmethod
     def create(
         num_layers: int,

@@ -48,6 +48,10 @@ class SinkKVCache(struct.PyTreeNode):
     seen: jax.Array
     num_sinks: int = struct.field(pytree_node=False)
 
+    # Generic-consumer layout (see DenseKVCache).
+    BATCH_AXES = {"k": 1, "v": 1, "seen": 0}
+    LAYER_FIELDS = ("k", "v")
+
     @staticmethod
     def create(
         num_layers: int,

@@ -192,15 +192,23 @@ def model_apply(
     cache,
     num_new: jnp.ndarray,
     attention_fn=gqa_attention,
+    block_fn=None,
 ):
     """Full model forward: embed → layers → final norm → logits.
 
     This is the client-side capability the reference lacks entirely (SURVEY §1:
     "There is no client layer"). Returns ``(logits[B, S, V], cache)`` with the
-    cache advanced.
+    cache advanced. ``block_fn`` overrides how the layer stack runs (e.g. the
+    ``pp``-staged pipeline, ``parallel/pipeline.py``); it must match
+    :func:`block_apply`'s signature minus ``attention_fn``.
     """
     x = jnp.take(params["embed"], tokens, axis=0)
-    x, cache = block_apply(cfg, params["layers"], x, cache, num_new, attention_fn)
+    if block_fn is None:
+        x, cache = block_apply(
+            cfg, params["layers"], x, cache, num_new, attention_fn
+        )
+    else:
+        x, cache = block_fn(cfg, params["layers"], x, cache, num_new)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:

@@ -1,4 +1,5 @@
 from .mesh import build_mesh, named_sharding, single_device_mesh
+from .pipeline import pipeline_block_apply, pipelined_model_apply
 from .tp import (
     cache_pspecs,
     layer_pspecs,
@@ -9,6 +10,8 @@ from .tp import (
 
 __all__ = [
     "build_mesh",
+    "pipeline_block_apply",
+    "pipelined_model_apply",
     "named_sharding",
     "single_device_mesh",
     "cache_pspecs",

@@ -1,0 +1,234 @@
+"""Pipeline parallelism: layer-block stages over the ``pp`` mesh axis.
+
+The reference's core design is pipeline distribution — a node serves a
+contiguous block of decoder layers (``LlamaBlock(config, layer_ids)``,
+``/root/reference/distributed_llm_inference/models/llama/model.py:17,22``;
+worker intent ``block_index_start/block_index_end``,
+``server/worker.py:13-14``) — but the stage-to-stage activation transport was
+never written (SURVEY §2.3). Intra-slice, TPU needs no transport at all: this
+module realizes the pipeline as a single SPMD program where
+
+* the stacked layer parameters and KV cache are sharded over ``pp`` on their
+  leading layer axis (each stage = one contiguous layer block);
+* activations hop stages via ``lax.ppermute`` — a collective permute XLA
+  compiles onto ICI links (the role NCCL send/recv would play);
+* the batch is split into microbatches on a GPipe schedule:
+  ``M + num_stages - 1`` iterations, stage ``s`` working on microbatch
+  ``t - s`` at iteration ``t``, bubbles masked out.
+
+``shard_map`` is manual over ``pp`` ONLY (``axis_names={"pp"}``): the ``dp``
+and ``tp`` axes stay automatic, so the Megatron shardings of ``parallel/tp.py``
+compose with pipelining with no model-code changes. Cross-host (DCN) pipelines
+— the reference's actual volunteer-network regime — are the distributed
+serving layer's job (``distributed/``), which chains per-host instances of this
+same program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models import llama
+from ..ops.attention import gqa_attention
+
+__all__ = ["pipeline_block_apply", "pipelined_model_apply"]
+
+
+def _mb_slice(arr: jnp.ndarray, axis: int, idx, dp: int, m: int) -> jnp.ndarray:
+    """Take microbatch ``idx`` from a batch axis factored as ``(dp, m, mbg)``.
+
+    The batch axis is block-sharded over ``dp`` (contiguous row groups per
+    replica), so a microbatch must take an equal row range from EVERY dp group
+    to keep the work dp-balanced (otherwise an iteration's rows all live on
+    one replica and the rest idle). Reshaping the axis to ``(dp, m, mbg)`` and
+    slicing the middle keeps every step a shard-local operation — no GSPMD
+    resharding.
+    """
+    b = arr.shape[axis]
+    mbg = b // (dp * m)
+    shape = arr.shape[:axis] + (dp, m, mbg) + arr.shape[axis + 1 :]
+    view = arr.reshape(shape)
+    sl = jax.lax.dynamic_slice_in_dim(view, idx, 1, axis + 1)
+    out_shape = arr.shape[:axis] + (dp * mbg,) + arr.shape[axis + 1 :]
+    return sl.reshape(out_shape)
+
+
+def _mb_update(arr: jnp.ndarray, val: jnp.ndarray, axis: int, idx, dp: int, m: int):
+    b = arr.shape[axis]
+    mbg = b // (dp * m)
+    shape = arr.shape[:axis] + (dp, m, mbg) + arr.shape[axis + 1 :]
+    vshape = arr.shape[:axis] + (dp, 1, mbg) + arr.shape[axis + 1 :]
+    view = jax.lax.dynamic_update_slice_in_dim(
+        arr.reshape(shape), val.reshape(vshape), idx, axis + 1
+    )
+    return view.reshape(arr.shape)
+
+
+def _cache_fields(cache: Any):
+    return [
+        f.name
+        for f in dataclasses.fields(cache)
+        if f.metadata.get("pytree_node", True)
+    ]
+
+
+def _rows(cache: Any, idx, dp: int, m: int) -> Any:
+    """Microbatch-``idx`` row view of a cache (dp-factored batch axis).
+
+    Field→axis layout comes from the cache class's ``BATCH_AXES`` declaration;
+    ``SHARED_FIELDS`` (e.g. the paged pool, which has no batch axis) pass
+    through whole.
+    """
+    shared = getattr(cache, "SHARED_FIELDS", ())
+    out = {}
+    for name in _cache_fields(cache):
+        if name in shared:
+            continue
+        out[name] = _mb_slice(
+            getattr(cache, name), cache.BATCH_AXES[name], idx, dp, m
+        )
+    return cache.replace(**out)
+
+
+def _merge_rows(cache: Any, sub: Any, idx, dp: int, m: int) -> Any:
+    shared = getattr(cache, "SHARED_FIELDS", ())
+    out = {}
+    for name in _cache_fields(cache):
+        if name in shared:
+            out[name] = getattr(sub, name)  # pool fields: take updated whole
+        else:
+            out[name] = _mb_update(
+                getattr(cache, name), getattr(sub, name),
+                cache.BATCH_AXES[name], idx, dp, m,
+            )
+    return cache.replace(**out)
+
+
+def _pp_specs(cache: Any) -> Any:
+    """shard_map specs for the cache: layer axis manual over ``pp``, rest
+    replicated w.r.t. ``pp`` (their ``dp``/``tp`` shardings stay automatic)."""
+    fields = {
+        name: P("pp") if name in cache.LAYER_FIELDS else P()
+        for name in _cache_fields(cache)
+    }
+    return cache.replace(**fields)
+
+
+def pipeline_block_apply(
+    cfg: ModelConfig,
+    layer_params: Any,
+    x: jnp.ndarray,
+    cache: Any,
+    num_new: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+    attention_fn=gqa_attention,
+) -> Tuple[jnp.ndarray, Any]:
+    """Run the full layer stack as a ``pp``-staged pipeline.
+
+    Same contract as :func:`models.llama.block_apply` (hidden states in/out,
+    cache k/v updated, lengths NOT advanced). ``layer_params`` and the cache's
+    k/v must be sharded over ``pp`` on the layer axis (``parallel/tp.py``
+    specs with ``use_pp=True``); layer count must divide evenly by the stage
+    count, and batch by the microbatch count.
+    """
+    num_stages = mesh.shape["pp"]
+    if num_stages == 1:
+        return llama.block_apply(cfg, layer_params, x, cache, num_new, attention_fn)
+
+    m = num_microbatches or num_stages
+    dp = mesh.shape["dp"]
+    b, s, h = x.shape
+    if b % (m * dp) != 0:
+        raise ValueError(
+            f"batch {b} not divisible by microbatches*dp = {m}*{dp} "
+            "(each microbatch takes an equal row range from every dp group)"
+        )
+    mb = b // m  # global rows per microbatch (dp*mbg)
+    stack = jax.tree.leaves(layer_params)[0].shape[0]
+    if stack % num_stages != 0:
+        raise ValueError(f"layer stack {stack} not divisible by pp={num_stages}")
+
+    def staged(local_layers, x_all, local_cache, num_new_all):
+        stage = jax.lax.axis_index("pp")
+
+        def iteration(t, carry):
+            x_buf, cache_c, outputs = carry
+            mb_idx = t - stage
+            valid = (mb_idx >= 0) & (mb_idx < m)
+            idx = jnp.clip(mb_idx, 0, m - 1)
+
+            x_in = jnp.where(stage == 0, _mb_slice(x_all, 0, idx, dp, m), x_buf)
+            sub = _rows(cache_c, idx, dp, m)
+            nn = _mb_slice(num_new_all, 0, idx, dp, m)
+            y, sub2 = llama.block_apply(
+                cfg, local_layers, x_in, sub, nn, attention_fn
+            )
+            # Bubbles must not write: keep the pre-step rows/pool.
+            sub2 = jax.tree.map(lambda a, b_: jnp.where(valid, a, b_), sub2, sub)
+            cache_c = _merge_rows(cache_c, sub2, idx, dp, m)
+
+            # Last stage emits finished microbatches.
+            out_idx = t - (num_stages - 1)
+            is_out = (stage == num_stages - 1) & (out_idx >= 0) & (out_idx < m)
+            oidx = jnp.clip(out_idx, 0, m - 1)
+            cur = _mb_slice(outputs, 0, oidx, dp, m)
+            outputs = _mb_update(
+                outputs, jnp.where(is_out, y, cur), 0, oidx, dp, m
+            )
+
+            x_next = jax.lax.ppermute(
+                y, "pp", [(i, i + 1) for i in range(num_stages - 1)]
+            )
+            return x_next, cache_c, outputs
+
+        outputs = jnp.zeros((b, s, h), x_all.dtype)
+        x_buf = jnp.zeros((mb, s, h), x_all.dtype)
+        x_buf, local_cache, outputs = jax.lax.fori_loop(
+            0, m + num_stages - 1, iteration, (x_buf, local_cache, outputs)
+        )
+        # Only the last stage holds real outputs; psum replicates them so the
+        # (auto-sharded) head computation downstream sees a full tensor.
+        outputs = jax.lax.psum(outputs, "pp")
+        return outputs, local_cache
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), layer_params)
+    fn = jax.shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(layer_specs, P(), _pp_specs(cache), P()),
+        out_specs=(P(), _pp_specs(cache)),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+    return fn(layer_params, x, cache, num_new)
+
+
+def pipelined_model_apply(
+    cfg: ModelConfig,
+    params: Any,
+    tokens: jnp.ndarray,
+    cache: Any,
+    num_new: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+    attention_fn=gqa_attention,
+) -> Tuple[jnp.ndarray, Any]:
+    """Full forward with the layer stack pipelined: the ``pp``-aware analog of
+    :func:`models.llama.model_apply` (same returns; cache advanced)."""
+
+    def block_fn(cfg_, layers_, x_, cache_, num_new_):
+        return pipeline_block_apply(
+            cfg_, layers_, x_, cache_, num_new_, mesh, num_microbatches,
+            attention_fn,
+        )
+
+    return llama.model_apply(
+        cfg, params, tokens, cache, num_new, attention_fn, block_fn=block_fn
+    )
