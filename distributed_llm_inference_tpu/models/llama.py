@@ -209,12 +209,18 @@ def model_apply(
         )
     else:
         x, cache = block_fn(cfg, params["layers"], x, cache, num_new)
+    logits = apply_head(cfg, params, x)
+    return logits, cache.advance(num_new)
+
+
+def apply_head(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + lm_head (tied to the embedding when absent): ``[..., H]``
+    hidden states → fp32 logits ``[..., V]``."""
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    logits = (x @ head).astype(jnp.float32)
-    return logits, cache.advance(num_new)
+    return (x @ head).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

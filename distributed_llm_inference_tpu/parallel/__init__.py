@@ -1,5 +1,6 @@
 from .mesh import build_mesh, named_sharding, single_device_mesh
 from .pipeline import pipeline_block_apply, pipelined_model_apply
+from .ring import dense_cache_from_ring, ring_gqa_attention, ring_prefill
 from .tp import (
     cache_pspecs,
     layer_pspecs,
@@ -12,6 +13,9 @@ __all__ = [
     "build_mesh",
     "pipeline_block_apply",
     "pipelined_model_apply",
+    "dense_cache_from_ring",
+    "ring_gqa_attention",
+    "ring_prefill",
     "named_sharding",
     "single_device_mesh",
     "cache_pspecs",

@@ -1,0 +1,231 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference's only long-context mechanism is the StreamingLLM sink cache
+(``/root/reference/distributed_llm_inference/models/llama/cache.py:111-133``);
+it has no ring attention, no sequence/context parallelism (SURVEY §2.3). This
+module adds the idiomatic TPU long-context path: for a long prefill, the
+sequence axis is sharded over ``sp`` and attention runs as a ring —
+
+* each device holds one query chunk and one KV chunk;
+* KV chunks (with their positions/validity) rotate around the ring via
+  ``lax.ppermute`` (compiled onto ICI) for ``sp`` steps;
+* each device folds every visiting KV chunk into its queries' attention with
+  the online-softmax (flash) recurrence: running max ``m``, normalizer ``l``,
+  and unnormalized accumulator — numerically identical to one global softmax.
+
+Like the pipeline, ``shard_map`` is manual over ``sp`` only, so ``tp``/``dp``
+shardings stay automatic and the same model code composes. The layer stack is
+reused verbatim through :class:`RingChunkCache` — an adapter that satisfies the
+cache protocol (``q_positions``/``update_and_gather``/``layer_kv``) for a
+fresh-chunk prefill, with the ring kernel injected as ``attention_fn``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models import llama
+from ..ops.attention import _NEG_INF, causal_mask
+from ..ops.rotary import apply_rope
+
+__all__ = ["ring_gqa_attention", "ring_prefill", "dense_cache_from_ring"]
+
+
+def ring_gqa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    kv_pos: jnp.ndarray,
+    kv_valid: jnp.ndarray,
+    scale: float,
+    axis_name: str = "sp",
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """GQA ring attention (call inside shard_map, manual over ``axis_name``).
+
+    ``q``: ``[B, Sl, Hq, D]`` local query chunk (rotated); ``k``/``v``:
+    ``[B, Tl, Hkv, D]`` local KV chunk; ``q_pos``/``kv_pos``: ``[B, Sl|Tl]``
+    global positions; ``kv_valid``: ``[B, Tl]``. Returns ``[B, Sl, Hq, D]``.
+    """
+    sp = jax.lax.axis_size(axis_name)
+    b, sl, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, sl, hkv, g, d)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, t):
+        k_c, v_c, pos_c, valid_c, m, l, acc = carry
+        scores = (
+            jnp.einsum(
+                "bskgd,btkd->bkgst", qg, k_c, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        mask = causal_mask(q_pos, pos_c, valid_c, sliding_window)
+        mask = mask[:, None, None]  # [B, 1, 1, Sl, Tl]
+        scores = jnp.where(mask, scores, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bkgst,btkd->bkgsd", p.astype(v_c.dtype), v_c,
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * alpha[..., None] + pv
+        # The last visit's rotation would be discarded — skip it (saves one
+        # full KV-chunk ppermute of ICI traffic per layer per ring pass).
+        rotated = jax.lax.cond(
+            t < sp - 1,
+            lambda args: tuple(
+                jax.lax.ppermute(x, axis_name, perm) for x in args
+            ),
+            lambda args: args,
+            (k_c, v_c, pos_c, valid_c),
+        )
+        return (*rotated, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, hkv, g, sl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sl), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, g, sl, d), jnp.float32)
+    carry, _ = jax.lax.scan(
+        step, (k, v, kv_pos, kv_valid, m0, l0, acc0), jnp.arange(sp)
+    )
+    _, _, _, _, _, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    # [B, Hkv, G, Sl, D] → [B, Sl, Hq, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sl, hq, d).astype(q.dtype)
+
+
+class RingChunkCache(struct.PyTreeNode):
+    """Cache-protocol adapter for a sequence-sharded fresh prefill.
+
+    Each ``sp`` device owns the chunk of global positions
+    ``[offset, offset + Sl)``; "updating" the cache is just capturing the
+    chunk's rotated k / v (the buffers double as the scan's per-layer stack).
+    ``num_new`` here is the per-row count of valid prompt tokens (rows shorter
+    than the global padded length simply mark their tail invalid).
+    """
+
+    k: jax.Array  # [L, B, Sl, Hkv, D]
+    v: jax.Array
+    offset: jax.Array  # scalar int32: global position of local column 0
+
+    BATCH_AXES = {"k": 1, "v": 1}
+    LAYER_FIELDS = ("k", "v")
+
+    @property
+    def layer_kv(self):
+        return self.k, self.v
+
+    def with_layer_kv(self, new_k, new_v) -> "RingChunkCache":
+        return self.replace(k=new_k, v=new_v)
+
+    def q_positions(self, seq_len: int) -> jnp.ndarray:
+        pos = self.offset + jnp.arange(seq_len, dtype=jnp.int32)
+        return jnp.broadcast_to(pos[None, :], (self.k.shape[1], seq_len))
+
+    def rope_positions(self, seq_len: int, num_new: jnp.ndarray) -> jnp.ndarray:
+        return self.q_positions(seq_len)
+
+    def update_and_gather(
+        self, layer_k, layer_v, q, k_new, v_new, rope, q_pos, num_new,
+        sliding_window=None,
+    ):
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        # mask=None: the ring attention_fn builds per-visit masks itself.
+        return q_rot, k_rot, v_new, None, k_rot, v_new
+
+    def advance(self, num_new: jnp.ndarray) -> "RingChunkCache":
+        return self
+
+
+def ring_prefill(
+    cfg: ModelConfig,
+    params: Any,
+    tokens: jnp.ndarray,
+    num_new: jnp.ndarray,
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sequence-parallel prefill of a (long) prompt from an empty cache.
+
+    ``tokens``: ``[B, S]`` with ``S`` divisible by the ``sp`` degree (pad to a
+    bucket); ``num_new``: ``[B]`` valid prompt lengths. Returns
+    ``(logits[B, 1, V] at each row's last valid position, ks, vs)`` where
+    ``ks``/``vs`` are ``[L, B, S, Hkv, D]`` rotated keys / values laid out
+    seq-sharded over ``sp`` — feed to :func:`dense_cache_from_ring` to decode.
+    """
+    sp = mesh.shape["sp"]
+    b, s = tokens.shape
+    if s % sp != 0:
+        raise ValueError(f"padded seq len {s} not divisible by sp={sp}")
+    sl = s // sp
+
+    def body(layers, embed, tokens_l, num_new_):
+        offset = jax.lax.axis_index("sp").astype(jnp.int32) * sl
+        x = jnp.take(embed, tokens_l, axis=0)
+        hkv, d = cfg.num_kv_heads, cfg.head_dim
+        cache = RingChunkCache(
+            k=jnp.zeros((cfg.num_layers, b, sl, hkv, d), x.dtype),
+            v=jnp.zeros((cfg.num_layers, b, sl, hkv, d), x.dtype),
+            offset=offset,
+        )
+        pos = offset + jnp.arange(sl, dtype=jnp.int32)
+        kv_pos = jnp.broadcast_to(pos[None, :], (b, sl))
+        kv_valid = kv_pos < num_new_[:, None]
+
+        def attention_fn(q, k, v, mask, scale):
+            return ring_gqa_attention(
+                q, k, v, kv_pos, kv_pos, kv_valid, scale,
+                sliding_window=cfg.sliding_window,
+            )
+
+        x, cache = llama.block_apply(cfg, layers, x, cache, num_new_, attention_fn)
+        return x, cache.k, cache.v
+
+    x, ks, vs = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(), P(None, "sp"), P()),
+        out_specs=(P(None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
+        axis_names={"sp"},
+        check_vma=False,
+    )(params["layers"], params["embed"], tokens, num_new)
+
+    # Head on each row's last valid position only (materializing [B, S, V]
+    # logits would defeat the point of a long-context prefill).
+    last = jnp.take_along_axis(
+        x, (num_new - 1)[:, None, None].astype(jnp.int32), axis=1
+    )
+    logits = llama.apply_head(cfg, params, last)
+    return logits, ks, vs
+
+
+def dense_cache_from_ring(
+    ks: jnp.ndarray,
+    vs: jnp.ndarray,
+    num_new: jnp.ndarray,
+    max_seq_len: int,
+):
+    """Build a :class:`cache.dense.DenseKVCache` (lengths advanced) from
+    ring-prefill KV, ready for standard decode. ``max_seq_len`` ≥ the prefill
+    length."""
+    from ..cache.dense import DenseKVCache
+
+    s = ks.shape[2]
+    if max_seq_len < s:
+        raise ValueError(f"max_seq_len {max_seq_len} < prefill length {s}")
+    pad = [(0, 0), (0, 0), (0, max_seq_len - s), (0, 0), (0, 0)]
+    return DenseKVCache(
+        k=jnp.pad(ks, pad), v=jnp.pad(vs, pad), lengths=num_new.astype(jnp.int32)
+    )
