@@ -118,18 +118,19 @@ class MeshConfig:
     pp: int = 1
     tp: int = 1
     sp: int = 1  # sequence/context parallel degree
+    ep: int = 1  # expert parallel degree (MoE experts sharded across devices)
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ("dp", "pp", "tp", "sp")
+        return ("dp", "pp", "ep", "tp", "sp")
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.pp, self.tp, self.sp)
+        return (self.dp, self.pp, self.ep, self.tp, self.sp)
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.pp * self.tp * self.sp
+        return self.dp * self.pp * self.ep * self.tp * self.sp
 
 
 @dataclasses.dataclass(frozen=True)

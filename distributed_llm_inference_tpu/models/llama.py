@@ -33,6 +33,7 @@ import numpy as np
 
 from ..config import ModelConfig
 from ..ops.attention import gqa_attention
+from ..ops.moe import moe_mlp
 from ..ops.norms import rms_norm
 from ..ops.rotary import RopeAngles, rope_cos_sin, rope_inv_freq
 
@@ -64,10 +65,17 @@ def init_layer_params(
         "wv": w(keys[2], h, hkv * d),
         "wo": w(keys[3], hq * d, h),
         "mlp_norm": jnp.ones((num_layers, h), dtype),
-        "wg": w(keys[4], h, inter),
-        "wu": w(keys[5], h, inter),
-        "wd": w(keys[6], inter, h),
     }
+    if cfg.num_experts > 0:
+        e = cfg.num_experts
+        p["router"] = w(keys[7], h, e)
+        p["we_g"] = w(keys[4], e, h, inter)
+        p["we_u"] = w(keys[5], e, h, inter)
+        p["we_d"] = w(keys[6], e, inter, h)
+    else:
+        p["wg"] = w(keys[4], h, inter)
+        p["wu"] = w(keys[5], h, inter)
+        p["wd"] = w(keys[6], inter, h)
     if cfg.qkv_bias:
         p["bq"] = jnp.zeros((num_layers, hq * d), dtype)
         p["bk"] = jnp.zeros((num_layers, hkv * d), dtype)
@@ -143,7 +151,10 @@ def _decoder_layer(
     x = x + o
 
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu(h2 @ p["wg"]) * (h2 @ p["wu"])) @ p["wd"]
+    if cfg.num_experts > 0:
+        mlp = moe_mlp(cfg, p, h2)
+    else:
+        mlp = (jax.nn.silu(h2 @ p["wg"]) * (h2 @ p["wu"])) @ p["wd"]
     return x + mlp, new_k, new_v
 
 
@@ -267,6 +278,18 @@ def convert_hf_layer(
         if transpose:
             arr = arr.T
         out[name] = arr.astype(jnp.dtype(dtype))
+    # Mixtral MoE: gate (router) + per-expert w1/w3/w2 → stacked [E, …].
+    gate_key = prefix + "block_sparse_moe.gate.weight"
+    if gate_key in state and cfg.num_experts > 0:
+        out["router"] = np.asarray(state[gate_key]).T.astype(jnp.dtype(dtype))
+        ep = prefix + "block_sparse_moe.experts.{e}.{w}.weight"
+        stack = lambda w: np.stack([
+            np.asarray(state[ep.format(e=e, w=w)]).T
+            for e in range(cfg.num_experts)
+        ]).astype(jnp.dtype(dtype))
+        out["we_g"] = stack("w1")  # gate_proj
+        out["we_d"] = stack("w2")  # down_proj
+        out["we_u"] = stack("w3")  # up_proj
     return out
 
 

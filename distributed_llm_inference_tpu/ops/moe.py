@@ -1,0 +1,57 @@
+"""Mixture-of-experts MLP (Mixtral-style) with expert-parallel sharding.
+
+The reference has no MoE layers — it only reuses hivemind's *moe.server*
+machinery for serving scaffolding (SURVEY §2.3;
+``/root/reference/distributed_llm_inference/server/backend.py:5``). MoE here is
+a capability extension required for the Mixtral model family.
+
+Routing follows Mixtral: softmax over ALL expert logits in fp32, top-k
+selection, renormalize the selected probabilities.
+
+Compute strategy: **dense-combine** — every expert processes every token and a
+``[B, S, E]`` combine matrix (zero off the top-k) weights the outputs. On TPU
+this keeps all shapes static and every FLOP on the MXU; with the experts axis
+sharded over the ``ep`` mesh axis, each device computes only its local experts
+and the combine contraction becomes a ``psum`` over ``ep`` that XLA inserts
+automatically. For E/k = 4 (Mixtral 8x7B, k=2) the overcompute is bounded and
+decode (S=1) stays bandwidth-bound; a sorted-dispatch (ragged) Pallas kernel is
+the prefill optimization path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+
+__all__ = ["moe_mlp", "router_weights"]
+
+
+def router_weights(
+    cfg: ModelConfig, x: jnp.ndarray, router: jnp.ndarray
+) -> jnp.ndarray:
+    """Mixtral routing: fp32 softmax over all experts → top-k → renormalize.
+
+    ``x``: ``[B, S, H]``; ``router``: ``[H, E]``. Returns the dense combine
+    matrix ``[B, S, E]`` (sums to 1 over the selected experts, 0 elsewhere).
+    """
+    logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    one_hot = jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
+    return jnp.einsum("bsk,bske->bse", top_p, one_hot)
+
+
+def moe_mlp(cfg: ModelConfig, p, x: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU expert MLPs + weighted combine.
+
+    ``p["router"]``: ``[H, E]``; ``p["we_g"]``/``p["we_u"]``: ``[E, H, F]``;
+    ``p["we_d"]``: ``[E, F, H]`` (E shardable over ``ep``, F over ``tp``).
+    """
+    combine = router_weights(cfg, x, p["router"]).astype(x.dtype)
+    t = jnp.einsum("bsh,ehf->bsef", x, p["we_g"])
+    u = jnp.einsum("bsh,ehf->bsef", x, p["we_u"])
+    y = jnp.einsum("bsef,efh->bseh", jax.nn.silu(t) * u, p["we_d"])
+    return jnp.einsum("bse,bseh->bsh", combine, y)

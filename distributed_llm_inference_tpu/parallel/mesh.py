@@ -11,6 +11,7 @@ and its inter-node fabric was to be hivemind's DHT/gRPC
 Axis meaning (order fixed, outer→inner for ICI locality):
     ``dp``   data parallel — batch rows, independent replicas
     ``pp``   pipeline parallel — layer-block stages (``parallel/pipeline.py``)
+    ``ep``   expert parallel — MoE experts (``ops/moe.py``)
     ``tp``   tensor parallel — attention heads / MLP features
     ``sp``   sequence/context parallel — sequence chunks (``parallel/ring.py``)
 
@@ -68,10 +69,13 @@ def build_mesh(
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
-    """A 1×1×1×1 mesh — lets all sharded code paths run unchanged on one chip."""
+    """An all-ones mesh — lets all sharded code paths run unchanged on one chip."""
     if device is None:
         device = jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), ("dp", "pp", "tp", "sp"))
+    cfg = MeshConfig()
+    return Mesh(
+        np.asarray([device]).reshape(cfg.shape), cfg.axis_names
+    )
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

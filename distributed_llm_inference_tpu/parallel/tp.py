@@ -53,12 +53,14 @@ _LAYER_RULES: Dict[str, P] = {
     "wg": P("pp", None, "tp"),
     "wu": P("pp", None, "tp"),
     "wd": P("pp", "tp", None),
-    # MoE (Mixtral): experts axis [L, E, in, out] — experts replicated across
-    # tp, features sharded like the dense MLP; router replicated.
+    # MoE (Mixtral): experts axis [L, E, in, out] — experts sharded over
+    # ``ep`` (each device computes its local experts; the combine contraction
+    # psums over ep), features over ``tp`` like the dense MLP; router
+    # replicated.
     "router": P("pp", None, None),
-    "we_g": P("pp", None, None, "tp"),
-    "we_u": P("pp", None, None, "tp"),
-    "we_d": P("pp", None, "tp", None),
+    "we_g": P("pp", "ep", None, "tp"),
+    "we_u": P("pp", "ep", None, "tp"),
+    "we_d": P("pp", "ep", "tp", None),
 }
 
 
@@ -127,7 +129,7 @@ def shard_pytree(tree: Any, mesh: Mesh, specs: Any) -> Any:
     )
 
 
-def validate_tp(cfg: ModelConfig, tp: int, sp: int = 1) -> None:
+def validate_tp(cfg: ModelConfig, tp: int, sp: int = 1, ep: int = 1) -> None:
     """Fail fast on invalid degree combinations (divisibility constraints)."""
     if cfg.num_kv_heads % tp != 0:
         raise ValueError(
@@ -145,3 +147,10 @@ def validate_tp(cfg: ModelConfig, tp: int, sp: int = 1) -> None:
             f"sp={sp} must divide num_heads={cfg.num_heads} (ring attention "
             "all-to-alls heads across sp)"
         )
+    if ep > 1:
+        if cfg.num_experts == 0:
+            raise ValueError(f"ep={ep} requires an MoE model (num_experts > 0)")
+        if cfg.num_experts % ep != 0:
+            raise ValueError(
+                f"ep={ep} must divide num_experts={cfg.num_experts}"
+            )
