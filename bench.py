@@ -19,6 +19,7 @@ import numpy as np
 from distributed_llm_inference_tpu.cache.dense import DenseKVCache
 from distributed_llm_inference_tpu.config import ModelConfig
 from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.ops.quant import QuantizedTensor, QUANTIZED_WEIGHTS
 
 NORTH_STAR_TOK_S_CHIP = 1000.0
 
@@ -70,6 +71,26 @@ def _zero_params(cfg: ModelConfig, dtype=jnp.bfloat16):
     }
 
 
+def _zero_qparams(cfg: ModelConfig):
+    """int8 zero-weight pytree built directly from config shapes (quantizing a
+    materialized 13.5 GB bf16 tree would peak above the 16 GB HBM)."""
+    shapes = jax.eval_shape(lambda: _zero_params(cfg))
+
+    def q(name, w):
+        if name not in QUANTIZED_WEIGHTS:
+            return jnp.ones(w.shape, w.dtype) if "norm" in name else jnp.zeros(
+                w.shape, w.dtype
+            )
+        return QuantizedTensor(
+            q=jnp.zeros(w.shape, jnp.int8),
+            scale=jnp.ones(w.shape[:-2] + w.shape[-1:], jnp.bfloat16),
+        )
+
+    out = {k: q(k, v) for k, v in shapes.items() if k != "layers"}
+    out["layers"] = {k: q(k, v) for k, v in shapes["layers"].items()}
+    return out
+
+
 def _try_decode_bench(cfg, params, batch, ctx, steps=32):
     """Decode throughput at ``batch``: tokens/sec on this one chip."""
     cache = DenseKVCache.create(
@@ -118,39 +139,60 @@ def _ttft_bench(cfg, params, prompt_len=128, reps=5):
     return float(np.percentile(times, 50))
 
 
-def main():
-    on_tpu = jax.default_backend() == "tpu"
-    cfg = LLAMA2_7B if on_tpu else TINY
-    params = _zero_params(cfg)
-    jax.block_until_ready(params)
-
-    tok_s = None
+def _decode_ladder(cfg, params, ladder):
+    """Largest-batch decode throughput that fits; ``(tok_s, batch)``."""
     err = None
-    for batch, ctx in ((8, 256), (4, 256), (2, 256), (1, 256)):
+    for batch, ctx in ladder:
         try:
-            tok_s = _try_decode_bench(cfg, params, batch, ctx)
-            break
-        except Exception as e:  # OOM on the tight 7B-bf16-in-16GB fit
+            return _try_decode_bench(cfg, params, batch, ctx), batch
+        except Exception as e:  # OOM on the tight 7B-in-16GB fit
             # repr, not the exception: a held traceback pins the failed
             # attempt's device buffers and starves the smaller-batch retry.
             err = repr(e)
             continue
-    if tok_s is None:
-        raise RuntimeError(f"all decode configs failed: {err}")
+    raise RuntimeError(f"all decode configs failed: {err}")
 
-    ttft_ms = _ttft_bench(cfg, params)
 
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA2_7B if on_tpu else TINY
+
+    # bf16 serving config.
+    params = _zero_params(cfg)
+    jax.block_until_ready(params)
+    bf16_tok_s, bf16_batch = _decode_ladder(
+        cfg, params, ((8, 256), (4, 256), (2, 256), (1, 256))
+    )
+    bf16_ttft = _ttft_bench(cfg, params)
+    del params  # free 13.5 GB of weights before the int8 tree
+
+    # int8 weight-only serving config: half the weight bytes -> roughly twice
+    # the decode bandwidth headroom, and room for 4x the batch.
+    qparams = _zero_qparams(cfg)
+    jax.block_until_ready(qparams)
+    int8_tok_s, int8_batch = _decode_ladder(
+        cfg, qparams, ((32, 256), (16, 256), (8, 256), (1, 256))
+    )
+    int8_ttft = _ttft_bench(cfg, qparams)
+
+    best, best_batch, best_dtype = max(
+        (bf16_tok_s, bf16_batch, "bfloat16"), (int8_tok_s, int8_batch, "int8"),
+    )
     print(json.dumps({
         "metric": "llama2_7b_decode_tok_per_sec_per_chip",
-        "value": round(tok_s, 2),
+        "value": round(best, 2),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tok_s / NORTH_STAR_TOK_S_CHIP, 4),
-        "p50_ttft_ms_bs1_prompt128": round(ttft_ms, 2),
-        "batch": batch,
+        "vs_baseline": round(best / NORTH_STAR_TOK_S_CHIP, 4),
+        "p50_ttft_ms_bs1_prompt128": round(min(bf16_ttft, int8_ttft), 2),
+        "batch": best_batch,
+        "weights": best_dtype,
+        "bf16": {"tok_s": round(bf16_tok_s, 2), "batch": bf16_batch,
+                 "ttft_ms": round(bf16_ttft, 2)},
+        "int8": {"tok_s": round(int8_tok_s, 2), "batch": int8_batch,
+                 "ttft_ms": round(int8_ttft, 2)},
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0].device_kind),
         "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
-        "dtype": "bfloat16",
     }))
 
 

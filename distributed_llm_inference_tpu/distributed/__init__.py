@@ -1,0 +1,24 @@
+"""Cross-host (DCN) tier: native relay transport, block directory, serving
+nodes, and the client/orchestrator — the layer hivemind provided (or the
+reference left as stubs). Intra-slice parallelism lives in ``parallel/``."""
+
+from .backend import BlockBackend, SchemaError
+from .client import DistributedClient
+from .directory import BlockDirectory, DirectoryClient, DirectoryService
+from .relay import RelayClient, RelayServer, native_available
+from .task_pool import TaskPool
+from .worker import ServingNode
+
+__all__ = [
+    "BlockBackend",
+    "SchemaError",
+    "DistributedClient",
+    "BlockDirectory",
+    "DirectoryClient",
+    "DirectoryService",
+    "RelayClient",
+    "RelayServer",
+    "native_available",
+    "TaskPool",
+    "ServingNode",
+]

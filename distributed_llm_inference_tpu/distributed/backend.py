@@ -1,0 +1,155 @@
+"""Inference backend: one resident layer-block behind jitted step functions.
+
+The TPU-native form of ``InferenceBackend``
+(``/root/reference/distributed_llm_inference/server/backend.py:11-51``):
+inference-only (no backward — ``backend.py:44-48``), declared I/O schema with
+the output schema inferred by a dummy forward (``backend.py:31-35``), and
+multi-tenant sessions keyed by ``generation_id``
+(``models/llama/cache.py:14-19``) mapped onto batch rows of one preallocated
+cache. All device computation is cached ``jax.jit`` executables — the role
+CUDA-graph capture plays in the reference (``utils/cuda.py:6``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cache.dense import DenseKVCache
+from ..config import ModelConfig
+from ..models import llama
+
+__all__ = ["BlockBackend", "SchemaError"]
+
+
+class SchemaError(ValueError):
+    pass
+
+
+class BlockBackend:
+    """Serves ``block_apply`` over layers ``[first_layer, last_layer]`` for up
+    to ``max_sessions`` interleaved generations."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        layer_params,
+        first_layer: int,
+        last_layer: int,
+        max_sessions: int = 8,
+        max_seq_len: int = 512,
+        dtype=jnp.bfloat16,
+        session_idle_timeout: float = 60.0,
+    ):
+        self.session_idle_timeout = session_idle_timeout
+        self.cfg = cfg
+        self.params = layer_params
+        self.first_layer, self.last_layer = first_layer, last_layer
+        self.num_block_layers = last_layer - first_layer + 1
+        self.max_sessions = max_sessions
+        self.max_seq_len = max_seq_len
+        self.dtype = jnp.dtype(dtype)
+
+        self.cache = DenseKVCache.create(
+            self.num_block_layers, max_sessions, max_seq_len,
+            cfg.num_kv_heads, cfg.head_dim, dtype,
+        )
+        # generation_id → (slot row, last-touch time); free slots LRU-reused.
+        self.sessions: Dict[str, Tuple[int, float]] = {}
+
+        def _row_step(params, x, cache, row, n_valid):
+            sub = cache.select_row(row)
+            y, sub = llama.block_apply(self.cfg, params, x, sub, n_valid[None])
+            sub = sub.advance(n_valid[None])
+            return y, cache.merge_row(sub, row)
+
+        self._row_step = jax.jit(_row_step, donate_argnums=(2,))
+
+        # Output schema inferred by a dummy forward (the reference's
+        # ``backend.py:31-35`` pattern): hidden-in → hidden-out, same shape.
+        probe = jnp.zeros((1, 1, cfg.hidden_size), dtype)
+        y, _ = self._row_step(
+            self.params, probe,
+            DenseKVCache.create(self.num_block_layers, 1, 8, cfg.num_kv_heads,
+                                cfg.head_dim, dtype),
+            jnp.int32(0), jnp.int32(1),
+        )
+        self.output_schema = {"shape_suffix": (cfg.hidden_size,),
+                              "dtype": str(y.dtype)}
+
+    # -- session management ---------------------------------------------------
+
+    def _slot_for(self, generation_id: str, create: bool) -> int:
+        if generation_id in self.sessions:
+            slot = self.sessions[generation_id][0]
+            self.sessions[generation_id] = (slot, time.monotonic())
+            return slot
+        if not create:
+            # Decode step for a session this node no longer holds (evicted,
+            # restarted, or never prefilled here) — silently creating an
+            # empty row would produce garbage tokens; fail loudly instead so
+            # the client can restart the generation.
+            raise KeyError(f"unknown generation {generation_id}")
+        used = {s for s, _ in self.sessions.values()}
+        free = [i for i in range(self.max_sessions) if i not in used]
+        if free:
+            slot = free[0]
+        else:
+            # Only sessions idle past the timeout may be evicted (abandoned
+            # generations); live sessions are never silently corrupted —
+            # admission fails instead and the client retries elsewhere.
+            now = time.monotonic()
+            idle = [
+                g for g, (_, touched) in self.sessions.items()
+                if now - touched >= self.session_idle_timeout
+            ]
+            if not idle:
+                raise RuntimeError(
+                    f"node full: {self.max_sessions} live sessions"
+                )
+            lru = min(idle, key=lambda g: self.sessions[g][1])
+            slot = self.sessions.pop(lru)[0]
+        self.sessions[generation_id] = (slot, time.monotonic())
+        self.cache = self.cache.reset_rows(
+            np.arange(self.max_sessions) == slot
+        )
+        return slot
+
+    def end(self, generation_id: str) -> None:
+        self.sessions.pop(generation_id, None)
+
+    @property
+    def load(self) -> int:
+        return len(self.sessions)
+
+    # -- forward --------------------------------------------------------------
+
+    def validate(self, x: np.ndarray, num_new: int) -> None:
+        if x.ndim != 3 or x.shape[0] != 1:
+            raise SchemaError(f"expected [1, S, H] hidden states, got {x.shape}")
+        if x.shape[-1] != self.cfg.hidden_size:
+            raise SchemaError(
+                f"hidden dim {x.shape[-1]} != {self.cfg.hidden_size}"
+            )
+        if not (0 < num_new <= x.shape[1]):
+            raise SchemaError(f"num_new {num_new} outside (0, {x.shape[1]}]")
+
+    def forward(
+        self, generation_id: str, x, num_new: int, create: bool = False
+    ) -> np.ndarray:
+        """Run the block for one session; ``x`` ``[1, S, H]`` (padded to a
+        bucket), ``num_new`` = valid token count. ``create`` admits a new
+        session (the prefill hop); decode hops require the session to exist.
+        Returns ``[1, S, H]``."""
+        xa = np.asarray(x)
+        self.validate(xa, num_new)
+        slot = self._slot_for(generation_id, create=create)
+        y, self.cache = self._row_step(
+            self.params, jnp.asarray(xa, self.dtype), self.cache,
+            jnp.int32(slot), jnp.int32(num_new),
+        )
+        return np.asarray(jax.device_get(y))

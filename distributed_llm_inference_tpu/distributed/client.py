@@ -1,0 +1,153 @@
+"""Client/orchestrator: the layer the reference never wrote.
+
+SURVEY §1: "There is no client layer (no code that runs the embedding/lm_head,
+routes a prompt through a chain of remote blocks, or samples tokens)". This is
+that layer: the client holds the embedding + final-norm + lm_head (the
+non-layer weights a block node never loads), asks the directory for a route
+covering all decoder layers, source-routes hidden states through the chain of
+block workers over the relay, and samples tokens.
+
+The per-request ``generation_id`` threads through every hop — the session key
+of the reference's multi-tenant cache design (``models/llama/model.py:27`` →
+``cache.py:74``) — so each worker pins the session to one cache row.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..models import llama
+from .directory import DirectoryClient
+from .messages import pack_frame, unpack_frame
+from .relay import RelayClient
+
+__all__ = ["DistributedClient"]
+
+
+class DistributedClient:
+    """Routes generations through remote block workers.
+
+    ``params`` needs ``embed``, ``final_norm`` and (unless tied) ``lm_head``
+    — e.g. from ``checkpoint.load_model_params`` or, leaner, a loader that
+    skips the decoder layers.
+    """
+
+    def __init__(
+        self,
+        relay_port: int,
+        cfg: ModelConfig,
+        params,
+        host: str = "127.0.0.1",
+        prefill_buckets: Sequence[int] = (32, 128, 512),
+        dtype=jnp.bfloat16,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.dtype = jnp.dtype(dtype)
+        self.prefill_buckets = tuple(prefill_buckets)
+        self.host, self.relay_port = host, relay_port
+        self.reply_queue = f"client.{uuid.uuid4().hex[:12]}"
+        self._relay = RelayClient(host, relay_port)
+        self._directory = DirectoryClient(relay_port, host)
+
+        self._embed = jax.jit(
+            lambda emb, t: jnp.take(emb, t, axis=0).astype(self.dtype)
+        )
+
+        def _head_last(params, x, idx):
+            last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
+            return llama.apply_head(self.cfg, params, last)
+
+        self._head_last = jax.jit(_head_last)
+
+    # -- routing --------------------------------------------------------------
+
+    def plan_route(self) -> List[dict]:
+        return self._directory.route(self.cfg.num_layers)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds largest bucket "
+            f"{self.prefill_buckets[-1]}"
+        )
+
+    def _send_through(self, route, gen_id: str, x: np.ndarray, num_new: int,
+                      timeout: float, new: bool = False) -> np.ndarray:
+        hops = [n["queue"] for n in route[1:]] + [self.reply_queue]
+        header = {"op": "forward", "gen_id": gen_id, "num_new": num_new,
+                  "hops": hops, "new": new}
+        self._relay.put(route[0]["queue"], pack_frame(header, np.asarray(x)))
+        reply_header, y = unpack_frame(self._relay.get(self.reply_queue,
+                                                       timeout=timeout))
+        if reply_header.get("op") == "error":
+            raise RuntimeError(
+                f"worker {reply_header.get('from')}: {reply_header['error']}"
+            )
+        if reply_header.get("gen_id") != gen_id:
+            raise RuntimeError("out-of-order reply (concurrent use of one "
+                               "client instance is not supported)")
+        return y
+
+    def _end_session(self, route, gen_id: str) -> None:
+        for node in route:
+            self._relay.put(node["queue"], pack_frame(
+                {"op": "end", "gen_id": gen_id}
+            ))
+
+    # -- generation -----------------------------------------------------------
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int = 16,
+        eos_token_id: Optional[int] = None,
+        timeout: float = 60.0,
+    ) -> List[int]:
+        """Greedy decode of one prompt through the remote chain."""
+        if not len(prompt):
+            raise ValueError("empty prompt")
+        route = self.plan_route()
+        gen_id = f"gen-{uuid.uuid4().hex[:12]}"
+        try:
+            # Prefill: embed the padded prompt, push through the chain.
+            n = len(prompt)
+            bucket = self._bucket(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = np.asarray(prompt, np.int32)
+            x = self._embed(self.params["embed"], jnp.asarray(padded))
+            y = self._send_through(route, gen_id, np.asarray(x), n, timeout,
+                                   new=True)
+            logits = self._head_last(self.params, jnp.asarray(y), n - 1)
+            token = int(jnp.argmax(logits[0, -1]))
+            out = [token]
+            # Decode loop: one hidden-state hop per token.
+            while len(out) < max_new_tokens and token != eos_token_id:
+                x = self._embed(
+                    self.params["embed"], jnp.asarray([[token]], jnp.int32)
+                )
+                y = self._send_through(route, gen_id, np.asarray(x), 1, timeout)
+                logits = self._head_last(self.params, jnp.asarray(y), 0)
+                token = int(jnp.argmax(logits[0, -1]))
+                out.append(token)
+            return out
+        finally:
+            self._end_session(route, gen_id)
+
+    def close(self) -> None:
+        self._relay.close()
+        self._directory.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

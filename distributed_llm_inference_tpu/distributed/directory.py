@@ -1,0 +1,246 @@
+"""Block directory: which node serves which decoder layers.
+
+Replaces the DHT the reference leaned on hivemind for (SURVEY §2.3 item 4,
+§5.8): nodes serving a contiguous layer block register under a lease and
+heartbeat to keep it alive (the serve-loop intent sketched at
+``/root/reference/distributed_llm_inference/server/server.py:13-24``); clients
+ask for a route — an ordered chain of nodes covering layers ``[0, L)``.
+
+The directory state is plain Python (``BlockDirectory``); ``DirectoryService``
+exposes it as a request/reply service over the activation relay (JSON frames,
+reply-queue pattern), so the whole control+data plane rides one native
+transport. Leases that miss their TTL expire and drop out of routing — the
+failure-detection half of SURVEY §5.3.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .relay import RelayClient
+
+__all__ = ["BlockDirectory", "DirectoryService", "DirectoryClient", "NodeInfo"]
+
+DIR_QUEUE = "directory.req"
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    first_layer: int
+    last_layer: int  # inclusive
+    queue: str  # relay queue the node's worker consumes
+    lease_expiry: float = 0.0
+    load: int = 0  # active sessions (rebalance hint)
+
+    def covers(self, layer: int) -> bool:
+        return self.first_layer <= layer <= self.last_layer
+
+
+class BlockDirectory:
+    """In-memory lease table. Thread-safe; embeds in the directory service
+    process (single-writer), the analog of a DHT's authoritative record."""
+
+    def __init__(self, default_ttl: float = 10.0):
+        self.default_ttl = default_ttl
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._lock = threading.Lock()
+
+    def register(
+        self, node_id: str, first_layer: int, last_layer: int, queue: str,
+        ttl: Optional[float] = None,
+    ) -> None:
+        if last_layer < first_layer:
+            raise ValueError(f"bad layer range [{first_layer}, {last_layer}]")
+        with self._lock:
+            self._nodes[node_id] = NodeInfo(
+                node_id, first_layer, last_layer, queue,
+                time.monotonic() + (ttl or self.default_ttl),
+            )
+
+    def heartbeat(self, node_id: str, load: int = 0, ttl: Optional[float] = None) -> bool:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None:
+                return False  # lease already expired: node must re-register
+            info.lease_expiry = time.monotonic() + (ttl or self.default_ttl)
+            info.load = load
+            return True
+
+    def remove(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def _expire_locked(self) -> None:
+        now = time.monotonic()
+        for nid in [n for n, i in self._nodes.items() if i.lease_expiry < now]:
+            del self._nodes[nid]
+
+    def alive(self) -> List[NodeInfo]:
+        with self._lock:
+            self._expire_locked()
+            return list(self._nodes.values())
+
+    def plan_route(self, num_layers: int) -> List[NodeInfo]:
+        """Greedy chain cover of layers ``[0, num_layers)``: at each position
+        pick the live node extending coverage furthest (least-loaded on
+        ties). Raises if there is a gap — the health signal a client acts on.
+        """
+        nodes = self.alive()
+        route: List[NodeInfo] = []
+        layer = 0
+        while layer < num_layers:
+            candidates = [
+                n for n in nodes if n.first_layer <= layer <= n.last_layer
+            ]
+            if not candidates:
+                raise LookupError(f"no live node serves layer {layer}")
+            best = max(candidates, key=lambda n: (n.last_layer, -n.load))
+            route.append(best)
+            layer = best.last_layer + 1
+        return route
+
+
+class DirectoryService:
+    """Serves a :class:`BlockDirectory` over the relay (background thread)."""
+
+    def __init__(self, relay_port: int, host: str = "127.0.0.1",
+                 default_ttl: float = 10.0):
+        self.directory = BlockDirectory(default_ttl)
+        self._client = RelayClient(host, relay_port)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                frame = self._client.get(DIR_QUEUE, timeout=0.5)
+            except TimeoutError:
+                continue
+            except (ConnectionError, OSError):
+                return
+            # A malformed request (garbage frame, missing reply_to) must not
+            # kill the control plane — drop it and keep serving.
+            try:
+                req = json.loads(frame)
+                reply_to = req["reply_to"]
+            except (ValueError, KeyError, TypeError):
+                continue
+            reply = self._handle(req)
+            reply["rid"] = req.get("rid")
+            try:
+                self._client.put(reply_to, json.dumps(reply).encode())
+            except (ConnectionError, OSError):
+                return
+
+    def _handle(self, req: dict) -> dict:
+        d = self.directory
+        try:
+            op = req["op"]
+            if op == "register":
+                d.register(req["node_id"], req["first_layer"],
+                           req["last_layer"], req["queue"], req.get("ttl"))
+                return {"ok": True}
+            if op == "heartbeat":
+                ok = d.heartbeat(req["node_id"], req.get("load", 0),
+                                 req.get("ttl"))
+                return {"ok": ok}
+            if op == "remove":
+                d.remove(req["node_id"])
+                return {"ok": True}
+            if op == "route":
+                route = d.plan_route(req["num_layers"])
+                return {"ok": True, "route": [
+                    {"node_id": n.node_id, "first_layer": n.first_layer,
+                     "last_layer": n.last_layer, "queue": n.queue}
+                    for n in route
+                ]}
+            if op == "alive":
+                return {"ok": True, "nodes": [
+                    {"node_id": n.node_id, "first_layer": n.first_layer,
+                     "last_layer": n.last_layer, "queue": n.queue,
+                     "load": n.load}
+                    for n in d.alive()
+                ]}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except (KeyError, ValueError, LookupError) as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class DirectoryClient:
+    """Node/client-side handle to the directory service."""
+
+    def __init__(self, relay_port: int, host: str = "127.0.0.1"):
+        self._client = RelayClient(host, relay_port)
+        self._reply_queue = f"directory.reply.{uuid.uuid4().hex}"
+        self._seq = 0
+
+    def _call(self, req: dict, timeout: float = 5.0) -> dict:
+        self._seq += 1
+        rid = self._seq
+        req["reply_to"] = self._reply_queue
+        req["rid"] = rid
+        self._client.put(DIR_QUEUE, json.dumps(req).encode())
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = max(deadline - time.monotonic(), 0.001)
+            reply = json.loads(
+                self._client.get(self._reply_queue, timeout=remaining)
+            )
+            if reply.get("rid") == rid:
+                break
+            # Stale reply from an earlier timed-out call: discard so the
+            # request/reply stream can never desync.
+        if not reply.get("ok", False) and "error" in reply:
+            kind = reply["error"].split(":", 1)[0]
+            exc = {"LookupError": LookupError, "ValueError": ValueError}.get(
+                kind, RuntimeError
+            )
+            raise exc(reply["error"])
+        return reply
+
+    def register(self, node_id: str, first_layer: int, last_layer: int,
+                 queue: str, ttl: Optional[float] = None) -> None:
+        self._call({"op": "register", "node_id": node_id,
+                    "first_layer": first_layer, "last_layer": last_layer,
+                    "queue": queue, "ttl": ttl})
+
+    def heartbeat(self, node_id: str, load: int = 0,
+                  ttl: Optional[float] = None) -> bool:
+        return self._call({"op": "heartbeat", "node_id": node_id,
+                           "load": load, "ttl": ttl})["ok"]
+
+    def remove(self, node_id: str) -> None:
+        self._call({"op": "remove", "node_id": node_id})
+
+    def route(self, num_layers: int) -> List[dict]:
+        return self._call({"op": "route", "num_layers": num_layers})["route"]
+
+    def alive(self) -> List[dict]:
+        return self._call({"op": "alive"})["nodes"]
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

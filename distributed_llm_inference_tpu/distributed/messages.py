@@ -1,0 +1,46 @@
+"""Data-plane frame format for the activation relay.
+
+One frame = ``[header_len:4 BE][JSON header][tensor payload]`` — the role
+msgpack/protobuf serialization plays inside hivemind's RPC (SURVEY §2.2 row
+5). The header carries routing (source-routed ``hops``) and session metadata;
+the payload is one tensor in ``RelayClient`` array framing (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .relay import RelayClient
+
+__all__ = ["pack_frame", "unpack_frame"]
+
+
+def pack_frame(header: Dict[str, Any], array: Optional[np.ndarray] = None) -> bytes:
+    h = json.dumps(header).encode()
+    if array is None:
+        payload = b""
+    else:
+        a = np.asarray(array)
+        if a.dtype.name == "bfloat16":
+            payload = RelayClient.encode_array(a.view(np.uint16), "bfloat16")
+        else:
+            payload = RelayClient.encode_array(a)
+    return struct.pack(">I", len(h)) + h + payload
+
+
+def unpack_frame(buf: bytes) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
+    (hlen,) = struct.unpack_from(">I", buf, 0)
+    header = json.loads(buf[4 : 4 + hlen].decode())
+    body = buf[4 + hlen :]
+    if not body:
+        return header, None
+    arr, dtype = RelayClient.decode_array(body)
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        arr = arr.view(ml_dtypes.bfloat16)
+    return header, arr

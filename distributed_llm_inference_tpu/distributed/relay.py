@@ -1,0 +1,236 @@
+"""Python driver for the native activation relay (``native/relay.cc``).
+
+The relay is the cross-host (DCN) tier of the communication backend — the
+role hivemind's libp2p/gRPC fabric plays in the reference (SURVEY §2.2 row 5,
+``/root/reference/distributed_llm_inference/server/backend.py:4-7``). The hub
+is C++ (epoll, zero-copy forwarding); endpoints speak a length-prefixed
+binary protocol over plain TCP sockets.
+
+``RelayServer`` loads the compiled ``.so`` via ctypes (built on demand with
+``g++`` — no pybind11 in this image) and runs the hub in-process.
+``RelayClient`` is a blocking endpoint with raw-bytes and numpy-tensor
+framing; pipeline stages use queue names like ``"stage3.in"``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["RelayServer", "RelayClient", "build_native", "native_available"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "relay.cc")
+_SO = os.path.join(_NATIVE_DIR, "_relay.so")
+_build_lock = threading.Lock()
+
+OP_PUT, OP_GET, OP_PING, OP_CANCEL = 1, 2, 3, 4
+CANCEL_ACK = (1 << 64) - 1
+
+
+def build_native(force: bool = False) -> str:
+    """Compile ``relay.cc`` → ``_relay.so`` (cached by source mtime)."""
+    with _build_lock:
+        if (
+            not force
+            and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return _SO
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO,
+             "-pthread"],
+            check=True,
+            capture_output=True,
+        )
+        return _SO
+
+
+def native_available() -> bool:
+    try:
+        build_native()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class RelayServer:
+    """In-process relay hub (the C++ epoll loop on a background thread)."""
+
+    def __init__(self, port: int = 0):
+        lib = ctypes.CDLL(build_native())
+        lib.relay_start.restype = ctypes.c_void_p
+        lib.relay_start.argtypes = [ctypes.c_int]
+        lib.relay_port.restype = ctypes.c_int
+        lib.relay_port.argtypes = [ctypes.c_void_p]
+        lib.relay_stop.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._handle = lib.relay_start(port)
+        if not self._handle:
+            raise OSError(f"relay failed to bind port {port}")
+        self.port = lib.relay_port(self._handle)
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.relay_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class RelayClient:
+    """Blocking relay endpoint.
+
+    One TCP connection; ``get`` parks server-side until a message arrives, so
+    use one client per consumer thread. On ``get`` timeout the connection is
+    recycled (the server drops dead waiters), keeping FIFO semantics clean.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._sock: Optional[socket.socket] = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- raw frames -----------------------------------------------------------
+
+    def _require_open(self) -> None:
+        if self._sock is None:
+            raise ConnectionError("relay client is closed")
+
+    def put(self, queue: str, payload: bytes) -> None:
+        self._require_open()
+        q = queue.encode()
+        header = struct.pack(">BH", OP_PUT, len(q)) + q + struct.pack(
+            ">Q", len(payload)
+        )
+        self._sock.sendall(header + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self._sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("relay connection closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def get(self, queue: str, timeout: Optional[float] = None) -> bytes:
+        self._require_open()
+        q = queue.encode()
+        self._sock.sendall(struct.pack(">BH", OP_GET, len(q)) + q)
+        # Timeout applies only to the FIRST byte: once the hub has started a
+        # reply it will deliver the whole frame, and timing out mid-frame
+        # would desync the stream (discarded partial length/payload bytes).
+        self._sock.settimeout(timeout)
+        try:
+            first = self._sock.recv(1)
+        except socket.timeout:
+            self._sock.settimeout(None)
+            return self._cancel_pending(queue, timeout)
+        finally:
+            if self._sock is not None:
+                self._sock.settimeout(None)
+        if not first:
+            raise ConnectionError("relay connection closed")
+        (length,) = struct.unpack(">Q", first + self._recv_exact(7))
+        return self._recv_exact(length)
+
+    def _cancel_pending(self, queue: str, timeout) -> bytes:
+        """Race-free GET timeout: CANCEL the parked waiter and read frames
+        until the ack sentinel. A real reply that raced ahead of the CANCEL
+        arrives before the ack — return it (arrived late beats lost)."""
+        self._sock.sendall(struct.pack(">BH", OP_CANCEL, 0))
+        self._sock.settimeout(10.0)
+        (length,) = struct.unpack(">Q", self._recv_exact(8))
+        if length == CANCEL_ACK:
+            raise TimeoutError(f"get({queue!r}) timed out after {timeout}s")
+        payload = self._recv_exact(length)
+        (ack,) = struct.unpack(">Q", self._recv_exact(8))
+        assert ack == CANCEL_ACK, "protocol desync after GET cancel"
+        return payload
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        self._require_open()
+        self._sock.sendall(struct.pack(">BH", OP_PING, 0))
+        self._sock.settimeout(timeout)
+        try:
+            (length,) = struct.unpack(">Q", self._recv_exact(8))
+            return self._recv_exact(length) == b"PONG"
+        finally:
+            self._sock.settimeout(None)
+
+    # -- tensor framing -------------------------------------------------------
+    # [dtype_len:1][dtype str][ndim:1][dims:8 each][raw bytes]; bfloat16
+    # travels as its raw uint16 bits with dtype tag "bfloat16".
+
+    @staticmethod
+    def encode_array(arr: np.ndarray, tag: Optional[str] = None) -> bytes:
+        dtype = (tag or arr.dtype.str).encode()
+        header = struct.pack(">B", len(dtype)) + dtype + struct.pack(
+            ">B", arr.ndim
+        ) + b"".join(struct.pack(">Q", d) for d in arr.shape)
+        return header + arr.tobytes()
+
+    @staticmethod
+    def decode_array(buf: bytes) -> Tuple[np.ndarray, str]:
+        (dlen,) = struct.unpack_from(">B", buf, 0)
+        dtype = buf[1 : 1 + dlen].decode()
+        off = 1 + dlen
+        (ndim,) = struct.unpack_from(">B", buf, off)
+        off += 1
+        shape = tuple(
+            struct.unpack_from(">Q", buf, off + 8 * i)[0] for i in range(ndim)
+        )
+        off += 8 * ndim
+        raw = np.frombuffer(
+            buf, dtype="<u2" if dtype == "bfloat16" else dtype, offset=off
+        )
+        return raw.reshape(shape), dtype
+
+    def put_array(self, queue: str, arr, tag: Optional[str] = None) -> None:
+        a = np.asarray(arr)
+        if a.dtype.name == "bfloat16":  # ml_dtypes: send raw bits
+            self.put(queue, self.encode_array(a.view(np.uint16), "bfloat16"))
+        else:
+            self.put(queue, self.encode_array(a, tag))
+
+    def get_array(self, queue: str, timeout: Optional[float] = None):
+        arr, dtype = self.decode_array(self.get(queue, timeout))
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            return arr.view(ml_dtypes.bfloat16)
+        return arr

@@ -59,8 +59,14 @@ class InferenceEngine:
         attention_fn=None,
     ):
         self.cfg = cfg
-        self.params = params
         self.ecfg = engine_cfg or EngineConfig()
+        if self.ecfg.quantization == "int8":
+            from ..ops.quant import quantize_params
+
+            params = quantize_params(params)
+        elif self.ecfg.quantization is not None:
+            raise ValueError(f"unknown quantization {self.ecfg.quantization!r}")
+        self.params = params
         self.ccfg = cache_cfg or CacheConfig()
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics = Metrics()

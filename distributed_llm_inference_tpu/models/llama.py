@@ -35,6 +35,7 @@ from ..config import ModelConfig
 from ..ops.attention import gqa_attention
 from ..ops.moe import moe_mlp
 from ..ops.norms import rms_norm
+from ..ops.quant import matmul as qmatmul
 from ..ops.rotary import RopeAngles, rope_cos_sin, rope_inv_freq
 
 Params = Dict[str, Any]
@@ -128,9 +129,9 @@ def _decoder_layer(
     hq, hkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-    q = h @ p["wq"]
-    k = h @ p["wk"]
-    v = h @ p["wv"]
+    q = qmatmul(h, p["wq"])
+    k = qmatmul(h, p["wk"])
+    v = qmatmul(h, p["wv"])
     # Biases applied iff the checkpoint carries them (HF `attention_bias`).
     if "bq" in p:
         q = q + p["bq"]
@@ -145,7 +146,7 @@ def _decoder_layer(
         sliding_window=cfg.sliding_window,
     )
     attn = attention_fn(q_rot, k_all, v_all, mask, scale=d**-0.5)
-    o = attn.reshape(b, s, hq * d) @ p["wo"]
+    o = qmatmul(attn.reshape(b, s, hq * d), p["wo"])
     if "bo" in p:
         o = o + p["bo"]
     x = x + o
@@ -154,7 +155,7 @@ def _decoder_layer(
     if cfg.num_experts > 0:
         mlp = moe_mlp(cfg, p, h2)
     else:
-        mlp = (jax.nn.silu(h2 @ p["wg"]) * (h2 @ p["wu"])) @ p["wd"]
+        mlp = qmatmul(jax.nn.silu(qmatmul(h2, p["wg"])) * qmatmul(h2, p["wu"]), p["wd"])
     return x + mlp, new_k, new_v
 
 
@@ -184,15 +185,30 @@ def block_apply(
     cos, sin = rope_cos_sin(rot_pos, inv_freq)
     rope = RopeAngles(inv_freq, cos, sin)
 
-    def step(carry_x, xs):
-        p, lk, lv = xs
-        out, new_k, new_v = _decoder_layer(
-            cfg, p, carry_x, lk, lv, cache, rope, q_pos, num_new, attention_fn
-        )
-        return out, (new_k, new_v)
-
     lk, lv = cache.layer_kv
-    x, (new_k, new_v) = jax.lax.scan(step, x, (layer_params, lk, lv))
+    num_stack = lk.shape[0]
+
+    # KV buffers ride the scan CARRY and are updated in place at the layer
+    # index — carries are aliased by XLA, so a decode step writes one token
+    # per layer. Returning per-layer KV as stacked scan outputs instead would
+    # materialize a full copy of the whole cache every step, doubling HBM
+    # traffic on the bandwidth-bound decode path.
+    def step(carry, xs):
+        x, ks, vs = carry
+        p, idx = xs
+        layer_k = jax.lax.dynamic_index_in_dim(ks, idx, 0, keepdims=False)
+        layer_v = jax.lax.dynamic_index_in_dim(vs, idx, 0, keepdims=False)
+        out, new_k, new_v = _decoder_layer(
+            cfg, p, x, layer_k, layer_v, cache, rope, q_pos, num_new,
+            attention_fn,
+        )
+        ks = jax.lax.dynamic_update_index_in_dim(ks, new_k, idx, 0)
+        vs = jax.lax.dynamic_update_index_in_dim(vs, new_v, idx, 0)
+        return (out, ks, vs), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        step, (x, lk, lv), (layer_params, jnp.arange(num_stack))
+    )
     return x, cache.with_layer_kv(new_k, new_v)
 
 
@@ -231,7 +247,7 @@ def apply_head(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    return (x @ head).astype(jnp.float32)
+    return qmatmul(x, head).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,387 @@
+// Activation relay: a native message hub for the cross-host (DCN) tier.
+//
+// TPU-native replacement for the transport the reference delegated entirely
+// to hivemind -- libp2p daemon + gRPC + msgpack (SURVEY §2.2 row 5;
+// /root/reference/distributed_llm_inference/server/backend.py:4-7 imports,
+// poetry.lock:485-488,367-370,692). Inside a slice, XLA collectives over ICI
+// replace networking altogether (parallel/); BETWEEN hosts, pipeline-stage
+// activations hop through this relay: a single epoll loop forwarding
+// length-prefixed binary frames between named FIFO queues.
+//
+// Protocol (all integers big-endian):
+//   request:  [op:1][qlen:2][queue bytes][len:8][payload]
+//     op 1 = PUT     payload appended to `queue` (no ack -- fire and forget)
+//     op 2 = GET     blocks until `queue` has a message; reply [len:8][payload]
+//     op 3 = PING    reply [len:8 = 4]["PONG"]  (health checks / liveness)
+//     op 4 = CANCEL  unpark this connection's pending GET; always acked with
+//                    the sentinel frame [len:8 = ~0]. If a reply raced ahead
+//                    of the CANCEL it precedes the ack on the wire, so the
+//                    client can distinguish "timed out" from "arrived late"
+//                    without tearing down the connection (a raw close loses
+//                    the message: the first TCP send after the peer's FIN
+//                    still succeeds).
+//   Multiple concurrent GETs on one queue are served FIFO. A connection that
+//   dies while parked requeues any reply it never received.
+//
+// Exposed as a C API (relay_start / relay_stop) so Python drives it via
+// ctypes -- no pybind11 in this image. Clients speak the socket protocol
+// directly (distributed_llm_inference_tpu/distributed/relay.py).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kOpPut = 1;
+constexpr uint8_t kOpGet = 2;
+constexpr uint8_t kOpPing = 3;
+constexpr uint8_t kOpCancel = 4;
+constexpr uint64_t kCancelAck = ~0ull;
+constexpr uint64_t kMaxPayload = 1ull << 30;  // 1 GiB per frame
+constexpr size_t kMaxQueueName = 255;
+
+struct Inflight {
+  std::string queue;  // source queue of an undelivered GET reply
+  uint64_t begin;     // total_enqueued before this reply's 8-byte length
+  uint64_t end;       // total_enqueued after the reply
+};
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> rbuf;   // partially received request bytes
+  std::vector<uint8_t> wbuf;   // pending reply bytes not yet written
+  size_t woff = 0;             // write offset into wbuf
+  bool parked = false;         // waiting in some queue's getter list
+  std::string parked_queue;
+  // Delivery tracking: a GET reply counts as delivered only once its bytes
+  // are fully flushed to the socket; replies still in flight when the
+  // connection dies are requeued so no message is ever lost to a dead getter.
+  uint64_t total_enqueued = 0;
+  uint64_t total_flushed = 0;
+  std::deque<Inflight> inflight;
+};
+
+struct Server {
+  int epfd = -1;
+  int listen_fd = -1;
+  int wake_fd = -1;  // eventfd: wakes the loop for shutdown
+  int port = 0;
+  std::thread loop;
+  volatile bool stopping = false;
+  std::map<int, Conn*> conns;
+  std::map<std::string, std::deque<std::vector<uint8_t>>> queues;
+  std::map<std::string, std::deque<int>> getters;  // parked conn fds, FIFO
+};
+
+void set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void be64(uint8_t* dst, uint64_t v) {
+  for (int i = 7; i >= 0; --i) {
+    dst[i] = v & 0xff;
+    v >>= 8;
+  }
+}
+
+uint64_t rd64(const uint8_t* src) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | src[i];
+  return v;
+}
+
+void arm_write(Server* s, Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->wbuf.size() > c->woff ? EPOLLOUT : 0u);
+  ev.data.fd = c->fd;
+  epoll_ctl(s->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void send_reply(Server* s, Conn* c, const uint8_t* payload, uint64_t len,
+                const std::string* track_queue = nullptr) {
+  size_t base = c->wbuf.size();
+  c->wbuf.resize(base + 8 + len);
+  be64(c->wbuf.data() + base, len);
+  if (len) memcpy(c->wbuf.data() + base + 8, payload, len);
+  uint64_t begin = c->total_enqueued;
+  c->total_enqueued += 8 + len;
+  // Tracking stores offsets only — the bytes live in wbuf; a second payload
+  // copy is taken just-in-time at requeue (connection death, the rare path).
+  if (track_queue) {
+    c->inflight.push_back({*track_queue, begin, c->total_enqueued});
+  }
+  arm_write(s, c);
+}
+
+void pump_queue(Server* s, const std::string& q);
+
+void close_conn(Server* s, Conn* c) {
+  if (c->parked) {
+    auto& dq = s->getters[c->parked_queue];
+    for (auto it = dq.begin(); it != dq.end(); ++it) {
+      if (*it == c->fd) {
+        dq.erase(it);
+        break;
+      }
+    }
+  }
+  epoll_ctl(s->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->fd);
+  // Requeue GET replies this connection never fully received (front-most
+  // first so FIFO order is preserved for the next getter). wbuf still holds
+  // every unflushed byte: it is only cleared when fully flushed, and then
+  // inflight is empty — so offset math into the current wbuf is safe.
+  std::vector<std::string> touched;
+  uint64_t wbase = c->total_enqueued - c->wbuf.size();
+  for (auto it = c->inflight.rbegin(); it != c->inflight.rend(); ++it) {
+    if (it->end > c->total_flushed) {
+      const uint8_t* p = c->wbuf.data() + (it->begin - wbase) + 8;
+      s->queues[it->queue].emplace_front(p, p + (it->end - it->begin - 8));
+      touched.push_back(it->queue);
+    }
+  }
+  delete c;
+  for (const auto& queue : touched) pump_queue(s, queue);
+}
+
+// Deliver queued messages to parked getters (called after every PUT/GET).
+void pump_queue(Server* s, const std::string& q) {
+  auto& msgs = s->queues[q];
+  auto& waiters = s->getters[q];
+  while (!msgs.empty() && !waiters.empty()) {
+    int fd = waiters.front();
+    waiters.pop_front();
+    auto it = s->conns.find(fd);
+    if (it == s->conns.end()) continue;  // getter died meanwhile
+    Conn* c = it->second;
+    c->parked = false;
+    send_reply(s, c, msgs.front().data(), msgs.front().size(), &q);
+    msgs.pop_front();
+  }
+  if (msgs.empty()) s->queues.erase(q);
+  if (waiters.empty()) s->getters.erase(q);
+}
+
+// Parse complete frames out of c->rbuf; returns false when c must close
+// (protocol violation).
+bool process_input(Server* s, Conn* c) {
+  for (;;) {
+    const uint8_t* b = c->rbuf.data();
+    size_t n = c->rbuf.size();
+    if (n < 3) return true;
+    uint8_t op = b[0];
+    uint16_t qlen = (uint16_t(b[1]) << 8) | b[2];
+    if (op < kOpPut || op > kOpCancel) return false;
+    if (qlen > kMaxQueueName) return false;
+    size_t header = 3 + qlen;
+    uint64_t plen = 0;
+    if (op == kOpPut) {
+      if (n < header + 8) return true;
+      plen = rd64(b + header);
+      if (plen > kMaxPayload) return false;
+      header += 8;
+    }
+    if (n < header + plen) return true;
+    std::string q(reinterpret_cast<const char*>(b + 3), qlen);
+
+    if (op == kOpPut) {
+      s->queues[q].emplace_back(b + header, b + header + plen);
+      pump_queue(s, q);
+    } else if (op == kOpGet) {
+      s->getters[q].push_back(c->fd);
+      c->parked = true;
+      c->parked_queue = q;
+      pump_queue(s, q);
+    } else if (op == kOpPing) {
+      send_reply(s, c, reinterpret_cast<const uint8_t*>("PONG"), 4);
+    } else {  // CANCEL
+      if (c->parked) {
+        auto& dq = s->getters[c->parked_queue];
+        for (auto it = dq.begin(); it != dq.end(); ++it) {
+          if (*it == c->fd) {
+            dq.erase(it);
+            break;
+          }
+        }
+        c->parked = false;
+      }
+      size_t base = c->wbuf.size();
+      c->wbuf.resize(base + 8);
+      be64(c->wbuf.data() + base, kCancelAck);
+      c->total_enqueued += 8;
+      arm_write(s, c);
+    }
+    c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + header + plen);
+  }
+}
+
+void loop_body(Server* s) {
+  epoll_event events[64];
+  while (!s->stopping) {
+    int nev = epoll_wait(s->epfd, events, 64, 200);
+    for (int i = 0; i < nev; ++i) {
+      int fd = events[i].data.fd;
+      if (fd == s->wake_fd) {
+        uint64_t tmp;
+        ssize_t r = read(s->wake_fd, &tmp, 8);
+        (void)r;
+        continue;
+      }
+      if (fd == s->listen_fd) {
+        for (;;) {
+          int cfd = accept(s->listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn* c = new Conn();
+          c->fd = cfd;
+          s->conns[cfd] = c;
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(s->epfd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      auto it = s->conns.find(fd);
+      if (it == s->conns.end()) continue;
+      Conn* c = it->second;
+      bool dead = false;
+      // NB: EPOLLHUP often arrives together with the connection's final
+      // data (fire-and-forget PUT then close). Drain and process the input
+      // FIRST; recv() returning 0 marks the connection dead afterwards.
+      if ((events[i].events & (EPOLLHUP | EPOLLERR)) &&
+          !(events[i].events & EPOLLIN)) {
+        dead = true;
+      }
+      if (events[i].events & EPOLLIN) {
+        uint8_t buf[1 << 16];
+        for (;;) {
+          ssize_t r = recv(fd, buf, sizeof(buf), 0);
+          if (r > 0) {
+            c->rbuf.insert(c->rbuf.end(), buf, buf + r);
+          } else if (r == 0) {
+            dead = true;
+            break;
+          } else {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            dead = true;
+            break;
+          }
+        }
+        // Process drained frames even when the peer already closed — a
+        // fire-and-forget PUT's bytes arrive together with the EOF.
+        if (!process_input(s, c)) dead = true;
+      }
+      if (!dead && (events[i].events & EPOLLOUT)) {
+        while (c->woff < c->wbuf.size()) {
+          ssize_t r =
+              send(fd, c->wbuf.data() + c->woff, c->wbuf.size() - c->woff, 0);
+          if (r > 0) {
+            c->woff += size_t(r);
+            c->total_flushed += uint64_t(r);
+            while (!c->inflight.empty() &&
+                   c->inflight.front().end <= c->total_flushed) {
+              c->inflight.pop_front();
+            }
+          } else {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            dead = true;
+            break;
+          }
+        }
+        if (c->woff == c->wbuf.size()) {
+          c->wbuf.clear();
+          c->woff = 0;
+        }
+        arm_write(s, c);
+      }
+      if (dead) close_conn(s, c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts the relay on `port` (0 = ephemeral) in a background thread.
+// Returns an opaque handle, or null on failure.
+void* relay_start(int port) {
+  Server* s = new Server();
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      listen(s->listen_fd, 128) < 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  set_nonblock(s->listen_fd);
+
+  s->epfd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = s->listen_fd;
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.data.fd = s->wake_fd;
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->wake_fd, &ev);
+
+  s->loop = std::thread(loop_body, s);
+  return s;
+}
+
+int relay_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+void relay_stop(void* handle) {
+  if (!handle) return;
+  Server* s = static_cast<Server*>(handle);
+  s->stopping = true;
+  uint64_t one = 1;
+  ssize_t r = write(s->wake_fd, &one, 8);
+  (void)r;
+  s->loop.join();
+  for (auto& [fd, c] : s->conns) {
+    close(fd);
+    delete c;
+  }
+  close(s->listen_fd);
+  close(s->wake_fd);
+  close(s->epfd);
+  delete s;
+}
+
+}  // extern "C"

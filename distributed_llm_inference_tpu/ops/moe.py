@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
+from . import quant
 
 __all__ = ["moe_mlp", "router_weights"]
 
@@ -51,7 +52,7 @@ def moe_mlp(cfg: ModelConfig, p, x: jnp.ndarray) -> jnp.ndarray:
     ``p["we_d"]``: ``[E, F, H]`` (E shardable over ``ep``, F over ``tp``).
     """
     combine = router_weights(cfg, x, p["router"]).astype(x.dtype)
-    t = jnp.einsum("bsh,ehf->bsef", x, p["we_g"])
-    u = jnp.einsum("bsh,ehf->bsef", x, p["we_u"])
-    y = jnp.einsum("bsef,efh->bseh", jax.nn.silu(t) * u, p["we_d"])
+    t = quant.einsum("bsh,ehf->bsef", x, p["we_g"])
+    u = quant.einsum("bsh,ehf->bsef", x, p["we_u"])
+    y = quant.einsum("bsef,efh->bseh", jax.nn.silu(t) * u, p["we_d"])
     return jnp.einsum("bse,bseh->bsh", combine, y)

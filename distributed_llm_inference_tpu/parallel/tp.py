@@ -81,18 +81,32 @@ def layer_pspecs(use_pp: bool = False) -> Dict[str, P]:
     return {k: _strip_pp(v, use_pp) for k, v in _LAYER_RULES.items()}
 
 
+def _maybe_qspec(param: Any, spec: P) -> Any:
+    """Weight spec → spec pytree; quantized weights need a matching
+    :class:`QuantizedTensor` node whose per-output-channel scale drops the
+    contracted (second-to-last) axis of the weight spec."""
+    from ..ops.quant import QuantizedTensor
+
+    if isinstance(param, QuantizedTensor):
+        return QuantizedTensor(q=spec, scale=P(*spec[:-2], spec[-1]))
+    return spec
+
+
 def param_pspecs(params: Dict[str, Any], use_pp: bool = False) -> Dict[str, Any]:
-    """Spec pytree matching a full or block-only param pytree."""
+    """Spec pytree matching a full or block-only param pytree (bf16 or
+    int8-quantized leaves)."""
     lp = layer_pspecs(use_pp)
     out: Dict[str, Any] = {}
     if "layers" in params:
-        out["layers"] = {k: lp[k] for k in params["layers"]}
+        out["layers"] = {
+            k: _maybe_qspec(v, lp[k]) for k, v in params["layers"].items()
+        }
     if "embed" in params:
         out["embed"] = P("tp", None)
     if "final_norm" in params:
         out["final_norm"] = P(None)
     if "lm_head" in params:
-        out["lm_head"] = P(None, "tp")
+        out["lm_head"] = _maybe_qspec(params["lm_head"], P(None, "tp"))
     return out
 
 

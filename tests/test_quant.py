@@ -1,0 +1,164 @@
+"""Weight-only int8 quantization: roundtrip accuracy, model fidelity,
+sharding composition, engine integration.
+
+Replaces the reference's bitsandbytes ``Linear8bitLt`` capability
+(``/root/reference/distributed_llm_inference/utils/model.py:93-123``) —
+no CUDA-only guard: int8 weights work on every backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.cache.dense import DenseKVCache
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    MeshConfig,
+    ModelConfig,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.ops.quant import (
+    QuantizedTensor,
+    matmul,
+    quantize_int8,
+    quantize_params,
+)
+from distributed_llm_inference_tpu.parallel import (
+    build_mesh,
+    cache_pspecs,
+    param_pspecs,
+    shard_pytree,
+)
+
+CFG = ModelConfig(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=8,
+    max_position_embeddings=64,
+)
+
+
+def test_quantize_roundtrip_error():
+    w = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+    qt = quantize_int8(jnp.asarray(w), scale_dtype=jnp.float32)
+    deq = np.asarray(qt.q, np.float32) * np.asarray(qt.scale)[None, :]
+    # Per-channel symmetric int8: max error ≤ scale/2 per element.
+    err = np.abs(deq - w)
+    bound = np.asarray(qt.scale)[None, :] * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_quantized_matmul_close():
+    r = np.random.RandomState(1)
+    x = r.randn(4, 64).astype(np.float32)
+    w = r.randn(64, 32).astype(np.float32)
+    qt = quantize_int8(jnp.asarray(w), scale_dtype=jnp.float32)
+    out = np.asarray(matmul(jnp.asarray(x), qt))
+    ref = x @ w
+    rel = np.abs(out - ref) / (np.abs(ref) + 1.0)
+    assert rel.mean() < 0.01
+
+
+def test_quantized_model_logits_close_and_structure():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, scale_dtype=jnp.float32)
+    assert isinstance(qparams["layers"]["wq"], QuantizedTensor)
+    assert qparams["layers"]["wq"].q.dtype == jnp.int8
+    assert isinstance(qparams["lm_head"], QuantizedTensor)
+    assert not isinstance(qparams["layers"]["attn_norm"], QuantizedTensor)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size)
+    n = jnp.full((2,), 8, jnp.int32)
+    mk = lambda: DenseKVCache.create(
+        CFG.num_layers, 2, 16, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+    )
+    ref, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+        params, tokens, mk()
+    )
+    out, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+        qparams, tokens, mk()
+    )
+    ref, out = np.asarray(ref), np.asarray(out)
+    # int8 noise: logits stay well-correlated with the fp32 model's.
+    cos = (ref * out).sum() / (np.linalg.norm(ref) * np.linalg.norm(out))
+    assert cos > 0.999, cos
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(tp=2),
+    MeshConfig(dp=2, tp=2),
+])
+def test_quantized_sharded_matches_single_device(mesh_cfg):
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, scale_dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size)
+    n = jnp.full((2,), 8, jnp.int32)
+    mk = lambda: DenseKVCache.create(
+        CFG.num_layers, 2, 16, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+    )
+    ref, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+        qparams, tokens, mk()
+    )
+    mesh = build_mesh(mesh_cfg)
+    sp = shard_pytree(qparams, mesh, param_pspecs(qparams))
+    sc = shard_pytree(mk(), mesh, cache_pspecs(mk()))
+    with mesh:
+        out, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+            sp, tokens, sc
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_quantized_moe_runs():
+    mcfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=64,
+        num_experts=4, num_experts_per_tok=2, family="mixtral",
+    )
+    params = llama.init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, scale_dtype=jnp.float32)
+    assert isinstance(qparams["layers"]["we_g"], QuantizedTensor)
+    tokens = jnp.ones((1, 4), jnp.int32)
+    n = jnp.full((1,), 4, jnp.int32)
+    cache = DenseKVCache.create(2, 1, 8, mcfg.num_kv_heads, mcfg.head_dim, jnp.float32)
+    ref, _ = jax.jit(lambda p, t, c: llama.model_apply(mcfg, p, t, c, n))(
+        params, tokens, cache
+    )
+    cache = DenseKVCache.create(2, 1, 8, mcfg.num_kv_heads, mcfg.head_dim, jnp.float32)
+    out, _ = jax.jit(lambda p, t, c: llama.model_apply(mcfg, p, t, c, n))(
+        qparams, tokens, cache
+    )
+    ref, out = np.asarray(ref), np.asarray(out)
+    cos = (ref * out).sum() / (np.linalg.norm(ref) * np.linalg.norm(out))
+    assert cos > 0.995, cos
+
+
+def test_engine_int8_generates():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(
+            max_batch_size=2, prefill_buckets=(16,), max_seq_len=32,
+            max_new_tokens=5, quantization="int8",
+        ),
+        CacheConfig(kind="dense"),
+    )
+    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor)
+    outs = eng.generate([[1, 2, 3]], SamplingOptions(temperature=0.0, max_new_tokens=5))
+    assert len(outs[0]) == 5
+
+
+def test_engine_rejects_unknown_quantization():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError):
+        InferenceEngine(
+            CFG, params, EngineConfig(quantization="fp4"), CacheConfig(kind="dense")
+        )
