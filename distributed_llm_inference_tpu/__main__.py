@@ -1,0 +1,7 @@
+"""``python -m distributed_llm_inference_tpu`` → the ``distribute`` CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

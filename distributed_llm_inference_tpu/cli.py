@@ -1,0 +1,244 @@
+"""``distribute`` — the CLI the reference shipped as a 0-byte placeholder.
+
+(``/root/reference/distribute`` is empty; SURVEY §2.1 row "Launcher".)
+
+Subcommands map onto the deployment roles:
+
+* ``relay``     run the native relay hub + block directory (control plane)
+* ``serve``     load a layer block from a checkpoint and serve it as a node
+* ``generate``  client: route a prompt through the registered nodes
+* ``local``     single-host serving: load a checkpoint into the continuous-
+                batching engine and generate (no relay needed)
+* ``info``      inspect a checkpoint (config, layer count, shard files)
+
+Examples::
+
+    distribute relay --port 18900
+    distribute serve --model /ckpt/llama --layers 0:16 --relay :18900
+    distribute serve --model /ckpt/llama --layers 16:32 --relay :18900
+    distribute generate --model /ckpt/llama --relay :18900 --prompt-ids 1,2,3
+    distribute local --model /ckpt/llama --prompt-ids 1,2,3 --max-new 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+def _parse_relay(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _parse_layers(spec: str) -> Tuple[int, int]:
+    """``a:b`` half-open (HF style) → inclusive (first, last)."""
+    a, _, b = spec.partition(":")
+    first, end = int(a), int(b)
+    if end <= first:
+        raise SystemExit(f"--layers {spec}: end must exceed start")
+    return first, end - 1
+
+
+def _parse_ids(spec: str) -> List[int]:
+    return [int(t) for t in spec.replace(" ", "").split(",") if t]
+
+
+def cmd_relay(args) -> int:
+    from .distributed.directory import DirectoryService
+    from .distributed.relay import RelayServer
+
+    server = RelayServer(args.port)
+    service = DirectoryService(server.port, default_ttl=args.lease_ttl)
+    print(json.dumps({"event": "relay_up", "port": server.port}), flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        service.stop()
+        server.stop()
+    return 0
+
+
+def cmd_serve(args) -> int:
+    import jax.numpy as jnp
+
+    from .distributed.worker import ServingNode
+    from .utils import checkpoint
+
+    host, port = _parse_relay(args.relay)
+    first, last = _parse_layers(args.layers)
+    cfg = checkpoint.load_config(args.model)
+    params = checkpoint.load_block_params(
+        args.model, cfg, list(range(first, last + 1)),
+        jnp.dtype(args.dtype),
+    )
+    node = ServingNode(
+        port, cfg, params["layers"], first, last, host=host,
+        node_id=args.node_id, max_sessions=args.max_sessions,
+        max_seq_len=args.max_seq_len, dtype=jnp.dtype(args.dtype),
+    )
+    print(json.dumps({
+        "event": "node_up", "node_id": node.node_id, "queue": node.queue,
+        "layers": [first, last],
+    }), flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop and node.is_healthy():
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_generate(args) -> int:
+    import jax.numpy as jnp
+
+    from .distributed.client import DistributedClient
+    from .utils import checkpoint
+
+    host, port = _parse_relay(args.relay)
+    cfg = checkpoint.load_config(args.model)
+    params = checkpoint.load_model_params(args.model, cfg, jnp.dtype(args.dtype))
+    prompt = _parse_ids(args.prompt_ids)
+    with DistributedClient(
+        port, cfg, params, host=host, dtype=jnp.dtype(args.dtype)
+    ) as client:
+        deadline = time.monotonic() + args.route_wait
+        while True:
+            try:
+                client.plan_route()
+                break
+            except LookupError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.3)
+        out = client.generate(
+            prompt, max_new_tokens=args.max_new, eos_token_id=args.eos
+        )
+    print(json.dumps({"event": "generated", "prompt": prompt, "tokens": out}),
+          flush=True)
+    return 0
+
+
+def cmd_local(args) -> int:
+    import jax.numpy as jnp
+
+    from .config import CacheConfig, EngineConfig
+    from .engine.engine import InferenceEngine
+    from .engine.sampling import SamplingOptions
+    from .utils import checkpoint
+
+    cfg = checkpoint.load_config(args.model)
+    params = checkpoint.load_model_params(args.model, cfg, jnp.dtype(args.dtype))
+    engine = InferenceEngine(
+        cfg, params,
+        EngineConfig(
+            max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
+            max_new_tokens=args.max_new, dtype=args.dtype,
+            quantization="int8" if args.int8 else None,
+        ),
+        CacheConfig(kind=args.cache),
+    )
+    prompt = _parse_ids(args.prompt_ids)
+    t0 = time.monotonic()
+    outs = engine.generate(
+        [prompt],
+        SamplingOptions(temperature=args.temperature,
+                        max_new_tokens=args.max_new,
+                        eos_token_id=args.eos if args.eos is not None else -1),
+    )
+    dt = time.monotonic() - t0
+    print(json.dumps({
+        "event": "generated", "prompt": prompt, "tokens": outs[0],
+        "seconds": round(dt, 3),
+        "metrics": engine.metrics.snapshot(),
+    }), flush=True)
+    return 0
+
+
+def cmd_info(args) -> int:
+    from .utils import checkpoint
+
+    cfg = checkpoint.load_config(args.model)
+    resolve = checkpoint._default_resolve(args.model)
+    entry = checkpoint.find_index(resolve)
+    print(json.dumps({
+        "model": args.model, "entry": entry, "family": cfg.family,
+        "num_layers": cfg.num_layers, "hidden_size": cfg.hidden_size,
+        "num_heads": cfg.num_heads, "num_kv_heads": cfg.num_kv_heads,
+        "vocab_size": cfg.vocab_size, "num_experts": cfg.num_experts,
+        "sliding_window": cfg.sliding_window,
+    }, indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distribute",
+        description="TPU-native distributed LLM inference launcher",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("relay", help="run the relay hub + block directory")
+    r.add_argument("--port", type=int, default=0)
+    r.add_argument("--lease-ttl", type=float, default=10.0)
+    r.set_defaults(fn=cmd_relay)
+
+    s = sub.add_parser("serve", help="serve a layer block from a checkpoint")
+    s.add_argument("--model", required=True)
+    s.add_argument("--layers", required=True, help="half-open range, e.g. 0:16")
+    s.add_argument("--relay", required=True, help="host:port of the relay")
+    s.add_argument("--node-id", default=None)
+    s.add_argument("--max-sessions", type=int, default=8)
+    s.add_argument("--max-seq-len", type=int, default=512)
+    s.add_argument("--dtype", default="bfloat16")
+    s.set_defaults(fn=cmd_serve)
+
+    g = sub.add_parser("generate", help="generate through registered nodes")
+    g.add_argument("--model", required=True)
+    g.add_argument("--relay", required=True)
+    g.add_argument("--prompt-ids", required=True, help="comma-separated ids")
+    g.add_argument("--max-new", type=int, default=16)
+    g.add_argument("--eos", type=int, default=None)
+    g.add_argument("--dtype", default="bfloat16")
+    g.add_argument("--route-wait", type=float, default=15.0,
+                   help="seconds to wait for full layer coverage")
+    g.set_defaults(fn=cmd_generate)
+
+    l = sub.add_parser("local", help="single-host engine generate")
+    l.add_argument("--model", required=True)
+    l.add_argument("--prompt-ids", required=True)
+    l.add_argument("--max-new", type=int, default=16)
+    l.add_argument("--eos", type=int, default=None)
+    l.add_argument("--temperature", type=float, default=0.0)
+    l.add_argument("--cache", default="paged",
+                   choices=("paged", "dense", "sink"))
+    l.add_argument("--int8", action="store_true")
+    l.add_argument("--max-sessions", type=int, default=8)
+    l.add_argument("--max-seq-len", type=int, default=2048)
+    l.add_argument("--dtype", default="bfloat16")
+    l.set_defaults(fn=cmd_local)
+
+    i = sub.add_parser("info", help="inspect a checkpoint")
+    i.add_argument("--model", required=True)
+    i.set_defaults(fn=cmd_info)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

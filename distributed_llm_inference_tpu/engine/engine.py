@@ -99,7 +99,11 @@ class InferenceEngine:
         self.waiting: collections.deque[Session] = collections.deque()
         self.slots: List[Optional[str]] = [None] * self.batch
 
-        attention = attention_fn if attention_fn is not None else None
+        attention = attention_fn
+        if attention is None and self.ecfg.use_pallas_attention:
+            from ..ops.flash_attention import flash_attention
+
+            attention = flash_attention  # falls back to XLA on decode shapes
         mkw = {} if attention is None else {"attention_fn": attention}
 
         def _prefill_row(params, tokens, cache, row, n_valid, key, sp):

@@ -1,0 +1,88 @@
+"""Speculative decoding: exact equivalence with target-only greedy decode.
+
+The invariant under test (the whole point of the design): speculation changes
+how many target forwards happen, never the tokens produced.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.cache.dense import DenseKVCache
+from distributed_llm_inference_tpu.config import ModelConfig
+from distributed_llm_inference_tpu.engine.speculative import SpeculativeDecoder
+from distributed_llm_inference_tpu.models import llama
+
+TARGET = ModelConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=3,
+    num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=128,
+)
+DRAFT = ModelConfig(
+    vocab_size=64, hidden_size=16, intermediate_size=32, num_layers=1,
+    num_heads=2, num_kv_heads=1, head_dim=8, max_position_embeddings=128,
+)
+
+
+def _greedy(cfg, params, prompt, steps):
+    cache = DenseKVCache.create(
+        cfg.num_layers, 1, 128, cfg.num_kv_heads, cfg.head_dim, jnp.float32
+    )
+    logits, cache = llama.model_apply(
+        cfg, params, jnp.asarray([prompt], jnp.int32), cache,
+        jnp.full((1,), len(prompt), jnp.int32),
+    )
+    tok = int(jnp.argmax(logits[0, len(prompt) - 1]))
+    out = [tok]
+    for _ in range(steps - 1):
+        logits, cache = llama.model_apply(
+            cfg, params, jnp.asarray([[tok]], jnp.int32), cache,
+            jnp.ones((1,), jnp.int32),
+        )
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+    return out
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_equals_greedy_weak_draft(k):
+    """A draft with unrelated weights: low acceptance, identical output."""
+    tp = llama.init_params(TARGET, jax.random.PRNGKey(0), jnp.float32)
+    dp = llama.init_params(DRAFT, jax.random.PRNGKey(7), jnp.float32)
+    dec = SpeculativeDecoder(TARGET, tp, DRAFT, dp, k=k, max_seq_len=128,
+                             dtype=jnp.float32)
+    got = dec.generate([3, 14, 15], max_new_tokens=20)
+    assert got == _greedy(TARGET, tp, [3, 14, 15], 20)
+    assert 0.0 <= dec.acceptance_rate <= 1.0
+
+
+def test_speculative_equals_greedy_perfect_draft():
+    """Draft == target: every proposal accepted, identical output."""
+    tp = llama.init_params(TARGET, jax.random.PRNGKey(1), jnp.float32)
+    dec = SpeculativeDecoder(TARGET, tp, TARGET, tp, k=4, max_seq_len=128,
+                             dtype=jnp.float32)
+    got = dec.generate([9, 2, 5, 5], max_new_tokens=17)
+    assert got == _greedy(TARGET, tp, [9, 2, 5, 5], 17)
+    assert dec.acceptance_rate == 1.0
+    # k+1 tokens per verify step: far fewer target steps than tokens.
+    assert dec.stats["steps"] <= (17 // 5) + 1
+
+
+def test_speculative_respects_eos():
+    tp = llama.init_params(TARGET, jax.random.PRNGKey(2), jnp.float32)
+    dp = llama.init_params(DRAFT, jax.random.PRNGKey(3), jnp.float32)
+    ref = _greedy(TARGET, tp, [1, 2], 30)
+    eos = ref[5]  # force an eos hit mid-stream
+    dec = SpeculativeDecoder(TARGET, tp, DRAFT, dp, k=3, max_seq_len=128,
+                             dtype=jnp.float32)
+    got = dec.generate([1, 2], max_new_tokens=30, eos_token_id=eos)
+    assert got == ref[: ref.index(eos) + 1]
+
+
+def test_rejects_mismatched_vocab():
+    bad = ModelConfig(vocab_size=32, hidden_size=16, intermediate_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=1, head_dim=8)
+    tp = llama.init_params(TARGET, jax.random.PRNGKey(0), jnp.float32)
+    bp = llama.init_params(bad, jax.random.PRNGKey(1), jnp.float32)
+    with pytest.raises(ValueError):
+        SpeculativeDecoder(TARGET, tp, bad, bp)
