@@ -106,8 +106,8 @@ class DenseKVCache(struct.PyTreeNode):
         """Per-row: can ``num_new`` more tokens be appended without overflow?
 
         The scheduler MUST check this before admitting tokens: past capacity,
-        ``dynamic_update_slice`` clamps the write offset and the cache silently
-        corrupts (engine contract, enforced in ``engine/scheduler.py``).
+        writes are dropped (see ``update_and_gather``) and the overflowing
+        tokens silently never enter the cache (engine contract).
         """
         return self.lengths + num_new <= self.max_len
 
@@ -133,13 +133,28 @@ class DenseKVCache(struct.PyTreeNode):
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
 
-        def write_row(buf, val, start):
-            return jax.lax.dynamic_update_slice(buf, val, (start, 0, 0))
-
-        new_k = jax.vmap(write_row)(layer_k, k_rot, self.lengths)
-        new_v = jax.vmap(write_row)(layer_v, v_new, self.lengths)
-
+        # Per-position scatter rather than a contiguous dynamic_update_slice:
+        # the incoming chunk is padded to a bucket that may extend past the
+        # buffer end (bucket > remaining capacity), and dynamic_update_slice
+        # would either fail to compile (update wider than operand) or clamp
+        # the start index and silently overwrite earlier tokens. Padding /
+        # out-of-capacity positions are routed out of bounds and dropped.
+        b, s, hkv, d = k_new.shape
         t = layer_k.shape[1]
+        writable = (
+            jnp.arange(s, dtype=jnp.int32)[None, :] < num_new[:, None]
+        ) & (q_pos < t)
+        write_pos = jnp.where(writable, q_pos, t)  # t = OOB → mode="drop"
+        bidx = jnp.broadcast_to(
+            jnp.arange(b, dtype=jnp.int32)[:, None], (b, s)
+        ).reshape(-1)
+        flat_pos = write_pos.reshape(-1)
+        new_k = layer_k.at[bidx, flat_pos].set(
+            k_rot.reshape(b * s, hkv, d), mode="drop"
+        )
+        new_v = layer_v.at[bidx, flat_pos].set(
+            v_new.reshape(b * s, hkv, d), mode="drop"
+        )
         kv_pos = jnp.broadcast_to(
             jnp.arange(t, dtype=jnp.int32)[None, :], (q.shape[0], t)
         )

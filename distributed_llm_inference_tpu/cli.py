@@ -32,6 +32,8 @@ from typing import List, Optional, Tuple
 
 def _parse_relay(addr: str) -> Tuple[str, int]:
     host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        raise SystemExit(f"--relay {addr!r}: expected host:port (e.g. :18900)")
     return host or "127.0.0.1", int(port)
 
 
@@ -108,7 +110,7 @@ def cmd_generate(args) -> int:
 
     host, port = _parse_relay(args.relay)
     cfg = checkpoint.load_config(args.model)
-    params = checkpoint.load_model_params(args.model, cfg, jnp.dtype(args.dtype))
+    params = checkpoint.load_client_params(args.model, cfg, jnp.dtype(args.dtype))
     prompt = _parse_ids(args.prompt_ids)
     with DistributedClient(
         port, cfg, params, host=host, dtype=jnp.dtype(args.dtype)

@@ -333,14 +333,26 @@ def convert_hf_state_dict(
     }
     params: Params = {"layers": stacked}
     if layer_ids is None:
-        params["embed"] = jnp.asarray(
+        params.update(convert_hf_non_layer(cfg, state, dtype))
+    return params
+
+
+def convert_hf_non_layer(
+    cfg: ModelConfig, state: Mapping[str, np.ndarray], dtype=jnp.bfloat16
+) -> Params:
+    """The client-side tensors (embedding, final norm, lm_head) — what a
+    mid-pipeline block node never loads (SURVEY §1: the reference has no
+    client layer at all)."""
+    params: Params = {
+        "embed": jnp.asarray(
             np.asarray(state["model.embed_tokens.weight"]).astype(jnp.dtype(dtype))
-        )
-        params["final_norm"] = jnp.asarray(
+        ),
+        "final_norm": jnp.asarray(
             np.asarray(state["model.norm.weight"]).astype(jnp.dtype(dtype))
+        ),
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in state:
+        params["lm_head"] = jnp.asarray(
+            np.asarray(state["lm_head.weight"]).T.astype(jnp.dtype(dtype))
         )
-        if not cfg.tie_word_embeddings and "lm_head.weight" in state:
-            params["lm_head"] = jnp.asarray(
-                np.asarray(state["lm_head.weight"]).T.astype(jnp.dtype(dtype))
-            )
     return params

@@ -30,11 +30,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import gqa_attention
+from .attention import _NEG_INF, gqa_attention
 
-__all__ = ["flash_attention", "flash_gqa_attention"]
-
-_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+__all__ = ["flash_attention"]
 
 
 def _flash_kernel(
@@ -122,7 +120,7 @@ def flash_attention(
     bk = min(block_k, t)
     # Tiling preconditions; anything else takes the always-correct XLA path
     # (notably S == 1 decode, whose attention is bandwidth-trivial).
-    if s % bq or t % bk or s < 8 or mask is None:
+    if s % bq or t % bk or s < 8 or mask is None or mask.ndim != 3:
         return gqa_attention(q, k, v, mask, scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -159,7 +157,3 @@ def flash_attention(
     )(qr, kr, vr, mask)
     # [B, Hkv, S, G, D] -> [B, S, Hq, D]
     return out.transpose(0, 2, 1, 3, 4).reshape(b, s, hq, d)
-
-
-# Engine-facing alias with the exact gqa_attention signature.
-flash_gqa_attention = flash_attention

@@ -39,7 +39,9 @@ __all__ = [
     "block_state_dict",
     "load_block_params",
     "load_model_params",
+    "load_client_params",
     "load_config",
+    "save_safetensors",
     "shard_put",
 ]
 
@@ -177,6 +179,37 @@ def load_model_params(
         model_dir, None, include_non_layer=True, resolve=resolve
     )
     return llama.convert_hf_state_dict(cfg, state, None, dtype)
+
+
+def load_client_params(
+    model_dir: str,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    resolve: Optional[Callable[[str], Optional[str]]] = None,
+) -> Dict[str, Any]:
+    """Embedding + final-norm + lm_head ONLY — what ``DistributedClient``
+    runs locally. Skips every decoder layer's shards, so a client fronting a
+    70B chain loads megabytes, not the full model."""
+    state = block_state_dict(model_dir, [], include_non_layer=True, resolve=resolve)
+    return llama.convert_hf_non_layer(cfg, state, dtype)
+
+
+def save_safetensors(state: Mapping[str, Any], path: str) -> None:
+    """Write an HF-keyed state dict as a ``.safetensors`` file (the save path
+    the reference lacks — its loader is read-only, ``utils/model.py``).
+
+    Every tensor is forced C-contiguous first: safetensors' numpy writer
+    serializes the array's underlying buffer without consulting strides, so a
+    transposed view — or an array fetched from a TPU device, which may come
+    back with a non-row-major layout — would be silently written with its
+    bytes permuted.
+    """
+    from safetensors.numpy import save_file
+
+    save_file(
+        {k: np.ascontiguousarray(np.asarray(v)) for k, v in state.items()},
+        path,
+    )
 
 
 def load_config(model_dir: str) -> ModelConfig:

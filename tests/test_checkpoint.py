@@ -56,7 +56,7 @@ def _hf_state(cfg: ModelConfig, seed: int = 0):
 
 def _write_sharded(tmp_path, state):
     """Two shards: layers 0-1 + embed in shard 1; layers 2-3 + norm/head in 2."""
-    from safetensors.numpy import save_file
+    from distributed_llm_inference_tpu.utils.checkpoint import save_safetensors
 
     def shard_of(key):
         for i in (2, 3):
@@ -73,7 +73,7 @@ def _write_sharded(tmp_path, state):
         shards.setdefault(s, {})[k] = v
         weight_map[k] = s
     for name, tensors in shards.items():
-        save_file(tensors, os.path.join(tmp_path, name))
+        save_safetensors(tensors, os.path.join(tmp_path, name))
     with open(os.path.join(tmp_path, "model.safetensors.index.json"), "w") as f:
         json.dump({"weight_map": weight_map}, f)
     with open(os.path.join(tmp_path, "config.json"), "w") as f:

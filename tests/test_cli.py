@@ -37,7 +37,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _write_checkpoint(tmp_path):
     """Tiny single-shard HF-format checkpoint from random init params."""
-    from safetensors.numpy import save_file
+    from distributed_llm_inference_tpu.utils.checkpoint import save_safetensors
 
     params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
     state = {}
@@ -56,7 +56,7 @@ def _write_checkpoint(tmp_path):
     state["model.embed_tokens.weight"] = np.asarray(params["embed"])
     state["model.norm.weight"] = np.asarray(params["final_norm"])
     state["lm_head.weight"] = np.asarray(params["lm_head"]).T
-    save_file(state, os.path.join(tmp_path, "model.safetensors"))
+    save_safetensors(state, os.path.join(tmp_path, "model.safetensors"))
     with open(os.path.join(tmp_path, "config.json"), "w") as f:
         json.dump({
             "model_type": "llama", "vocab_size": CFG.vocab_size,
