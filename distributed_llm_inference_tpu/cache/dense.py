@@ -133,28 +133,38 @@ class DenseKVCache(struct.PyTreeNode):
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
 
-        # Per-position scatter rather than a contiguous dynamic_update_slice:
-        # the incoming chunk is padded to a bucket that may extend past the
-        # buffer end (bucket > remaining capacity), and dynamic_update_slice
-        # would either fail to compile (update wider than operand) or clamp
-        # the start index and silently overwrite earlier tokens. Padding /
-        # out-of-capacity positions are routed out of bounds and dropped.
         b, s, hkv, d = k_new.shape
         t = layer_k.shape[1]
-        writable = (
-            jnp.arange(s, dtype=jnp.int32)[None, :] < num_new[:, None]
-        ) & (q_pos < t)
-        write_pos = jnp.where(writable, q_pos, t)  # t = OOB → mode="drop"
-        bidx = jnp.broadcast_to(
-            jnp.arange(b, dtype=jnp.int32)[:, None], (b, s)
-        ).reshape(-1)
-        flat_pos = write_pos.reshape(-1)
-        new_k = layer_k.at[bidx, flat_pos].set(
-            k_rot.reshape(b * s, hkv, d), mode="drop"
-        )
-        new_v = layer_v.at[bidx, flat_pos].set(
-            v_new.reshape(b * s, hkv, d), mode="drop"
-        )
+        if s == 1:
+            # Decode hot path: single-token contiguous write. Always in
+            # bounds — the scheduler's capacity check guarantees
+            # ``lengths + 1 <= max_len`` for active rows — and it partitions
+            # cleanly under SPMD (a scatter here trips XLA's partitioner).
+            def write_row(buf, val, start):
+                return jax.lax.dynamic_update_slice(buf, val, (start, 0, 0))
+
+            new_k = jax.vmap(write_row)(layer_k, k_rot, self.lengths)
+            new_v = jax.vmap(write_row)(layer_v, v_new, self.lengths)
+        else:
+            # Prefill: the chunk is padded to a bucket that may extend past
+            # the buffer end (bucket > remaining capacity), where a contiguous
+            # dynamic_update_slice would either fail to compile (update wider
+            # than operand) or clamp the start offset and silently overwrite
+            # earlier tokens. Rebuild the buffer as a gather + select instead
+            # (SPMD-friendly, unlike a scatter): buffer position p takes
+            # incoming row ``p - lengths`` when that lies in [0, num_new).
+            src = (
+                jnp.arange(t, dtype=jnp.int32)[None, :] - self.lengths[:, None]
+            )  # [B, T]: index into the incoming chunk
+            take = (src >= 0) & (src < num_new[:, None])
+            idx = jnp.clip(src, 0, s - 1)[:, :, None, None]
+            sel = take[:, :, None, None]
+            new_k = jnp.where(
+                sel, jnp.take_along_axis(k_rot, idx, axis=1), layer_k
+            )
+            new_v = jnp.where(
+                sel, jnp.take_along_axis(v_new, idx, axis=1), layer_v
+            )
         kv_pos = jnp.broadcast_to(
             jnp.arange(t, dtype=jnp.int32)[None, :], (q.shape[0], t)
         )
