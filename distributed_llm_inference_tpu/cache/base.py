@@ -1,0 +1,38 @@
+"""Shared cache behavior: the ``attend`` step.
+
+``attend`` is the single entry the model layer calls per decoder layer
+(``models/llama.py:_decoder_layer``): write the new k/v into the cache, run
+attention, return ``(attn_out, new_layer_k, new_layer_v)``. The default
+implementation is the always-correct XLA path — ``update_and_gather`` into a
+contiguous view, then the caller-supplied ``attention_fn``. Cache policies
+override it to fuse cache reads into a kernel (``PagedKVCache`` +
+``ops/paged_attention.py`` reads pages in place at decode).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class GatherAttendMixin:
+    """Default ``attend``: gather-to-contiguous + ``attention_fn``."""
+
+    def attend(
+        self,
+        layer_k,
+        layer_v,
+        q,
+        k_new,
+        v_new,
+        rope,
+        q_pos,
+        num_new,
+        sliding_window: Optional[int],
+        attention_fn,
+        scale: Optional[float] = None,
+    ):
+        q_rot, k_all, v_all, mask, new_k, new_v = self.update_and_gather(
+            layer_k, layer_v, q, k_new, v_new, rope, q_pos, num_new,
+            sliding_window=sliding_window,
+        )
+        return attention_fn(q_rot, k_all, v_all, mask, scale=scale), new_k, new_v

@@ -25,9 +25,10 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
+from .base import GatherAttendMixin
 
 
-class DenseKVCache(struct.PyTreeNode):
+class DenseKVCache(GatherAttendMixin, struct.PyTreeNode):
     """``k``/``v``: ``[L, B, T, Hkv, D]`` (keys stored rotated); ``lengths``: ``[B]``."""
 
     k: jax.Array

@@ -11,7 +11,10 @@ scheduler (``engine/scheduler.py``) and the device computation only ever sees
 static shapes.
 
 Layout:
-    ``k_pages``/``v_pages``: ``[L, num_pages, page_size, Hkv, D]`` (keys rotated)
+    ``k_pages``/``v_pages``: ``[L, num_pages, Hkv, page_size, D]`` (keys
+    rotated; head-major within a page so the Pallas paged kernel's per-head
+    block is a contiguous ``[page_size, D]`` tile — TPU Pallas requires the
+    last two block dims to be tiling-aligned)
     ``page_table``: ``[B, max_pages_per_session]`` int32 page ids
     ``lengths``: ``[B]`` tokens currently cached per session row
 
@@ -30,14 +33,18 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
+from .base import GatherAttendMixin
 
 
-class PagedKVCache(struct.PyTreeNode):
+class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     k_pages: jax.Array
     v_pages: jax.Array
     page_table: jax.Array
     lengths: jax.Array
     page_size: int = struct.field(pytree_node=False)
+    # Use the Pallas paged-attention kernel for decode steps (reads pages in
+    # place instead of gathering a contiguous per-row view).
+    use_kernel: bool = struct.field(pytree_node=False, default=False)
 
     # Generic-consumer layout (see DenseKVCache): the page pool is batch-free;
     # only the table/lengths have session rows. Pool fields carry the layer
@@ -56,14 +63,16 @@ class PagedKVCache(struct.PyTreeNode):
         num_kv_heads: int,
         head_dim: int,
         dtype=jnp.bfloat16,
+        use_kernel: bool = False,
     ) -> "PagedKVCache":
-        shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+        shape = (num_layers, num_pages, num_kv_heads, page_size, head_dim)
         return PagedKVCache(
             k_pages=jnp.zeros(shape, dtype),
             v_pages=jnp.zeros(shape, dtype),
             page_table=jnp.zeros((batch, max_pages_per_session), jnp.int32),
             lengths=jnp.zeros((batch,), jnp.int32),
             page_size=page_size,
+            use_kernel=use_kernel,
         )
 
     @property
@@ -88,29 +97,18 @@ class PagedKVCache(struct.PyTreeNode):
         scheduler must have mapped enough pages in ``page_table``."""
         return self.lengths + num_new <= self.max_len
 
-    def update_and_gather(
+    def _scatter(
         self,
         layer_k: jnp.ndarray,
         layer_v: jnp.ndarray,
-        q: jnp.ndarray,
-        k_new: jnp.ndarray,
+        k_rot: jnp.ndarray,
         v_new: jnp.ndarray,
-        rope: RopeAngles,
         q_pos: jnp.ndarray,
         num_new: jnp.ndarray,
-        sliding_window: Optional[int] = None,
-    ) -> Tuple[jnp.ndarray, ...]:
-        """Scatter new k/v into pages; gather each row's pages for attention.
-
-        ``layer_k``/``layer_v``: ``[P, page_size, Hkv, D]`` (one layer).
-        The gather materializes ``[B, max_pages_per_session * page_size, …]``
-        per layer — the XLA-fused correctness baseline. The Pallas paged
-        kernel (``ops/paged_attention.py``) reads pages in place instead.
-        """
-        b, s, hkv, d = k_new.shape
-        q_rot = apply_rope(q, rope.cos, rope.sin)
-        k_rot = apply_rope(k_new, rope.cos, rope.sin)
-
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Scatter rotated k / raw v into the page pool at each incoming
+        token's (physical page, offset) per the row's page table."""
+        b, s, hkv, d = k_rot.shape
         # Map each incoming token's absolute position → (physical page, offset).
         table_slot = q_pos // self.page_size  # [B, S]
         offset = q_pos % self.page_size
@@ -126,21 +124,87 @@ class PagedKVCache(struct.PyTreeNode):
 
         flat_page = phys_page.reshape(-1)
         flat_off = offset.reshape(-1)
-        new_k = layer_k.at[flat_page, flat_off].set(
+        # Pool is [P, Hkv, PS, D]: advanced indices (page, offset) around the
+        # head slice put the broadcast dim first → writes are [N, Hkv, D].
+        new_k = layer_k.at[flat_page, :, flat_off].set(
             k_rot.reshape(b * s, hkv, d), mode="drop"
         )
-        new_v = layer_v.at[flat_page, flat_off].set(
+        new_v = layer_v.at[flat_page, :, flat_off].set(
             v_new.reshape(b * s, hkv, d), mode="drop"
+        )
+        return new_k, new_v
+
+    def attend(
+        self,
+        layer_k,
+        layer_v,
+        q,
+        k_new,
+        v_new,
+        rope,
+        q_pos,
+        num_new,
+        sliding_window,
+        attention_fn,
+        scale=None,
+    ):
+        """Decode steps with ``use_kernel``: scatter into the pool, then run
+        the Pallas paged kernel over the pages in place — no contiguous
+        gather. Prefill (S>1) and the non-kernel path use the default
+        gather+``attention_fn`` (``GatherAttendMixin``)."""
+        if not self.use_kernel or q.shape[1] != 1:
+            return super().attend(
+                layer_k, layer_v, q, k_new, v_new, rope, q_pos, num_new,
+                sliding_window, attention_fn, scale,
+            )
+        from ..ops.paged_attention import paged_attention
+
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        new_k, new_v = self._scatter(
+            layer_k, layer_v, k_rot, v_new, q_pos, num_new
+        )
+        out = paged_attention(
+            q_rot, new_k, new_v, self.page_table, self.lengths + num_new,
+            scale=scale, sliding_window=sliding_window,
+        )
+        return out, new_k, new_v
+
+    def update_and_gather(
+        self,
+        layer_k: jnp.ndarray,
+        layer_v: jnp.ndarray,
+        q: jnp.ndarray,
+        k_new: jnp.ndarray,
+        v_new: jnp.ndarray,
+        rope: RopeAngles,
+        q_pos: jnp.ndarray,
+        num_new: jnp.ndarray,
+        sliding_window: Optional[int] = None,
+    ) -> Tuple[jnp.ndarray, ...]:
+        """Scatter new k/v into pages; gather each row's pages for attention.
+
+        ``layer_k``/``layer_v``: ``[P, Hkv, page_size, D]`` (one layer).
+        The gather materializes ``[B, max_pages_per_session * page_size, …]``
+        per layer — the XLA-fused correctness baseline. The Pallas paged
+        kernel (``ops/paged_attention.py``) reads pages in place instead.
+        """
+        b, s, hkv, d = k_new.shape
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        new_k, new_v = self._scatter(
+            layer_k, layer_v, k_rot, v_new, q_pos, num_new
         )
 
         # Gather this row's pages into a contiguous view. Slot i of the view
         # holds absolute position i because table slots are position-ordered.
-        k_all = jnp.take(new_k, self.page_table, axis=0).reshape(
-            b, self.max_len, hkv, d
-        )
-        v_all = jnp.take(new_v, self.page_table, axis=0).reshape(
-            b, self.max_len, hkv, d
-        )
+        # [B, T, Hkv, PS, D] → [B, T, PS, Hkv, D] → [B, max_len, Hkv, D].
+        k_all = jnp.take(new_k, self.page_table, axis=0).transpose(
+            0, 1, 3, 2, 4
+        ).reshape(b, self.max_len, hkv, d)
+        v_all = jnp.take(new_v, self.page_table, axis=0).transpose(
+            0, 1, 3, 2, 4
+        ).reshape(b, self.max_len, hkv, d)
 
         kv_pos = jnp.broadcast_to(
             jnp.arange(self.max_len, dtype=jnp.int32)[None, :], (b, self.max_len)

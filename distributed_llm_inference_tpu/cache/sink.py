@@ -37,9 +37,10 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope, rope_cos_sin
+from .base import GatherAttendMixin
 
 
-class SinkKVCache(struct.PyTreeNode):
+class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
     """``k`` (unrotated)/``v``: ``[L, B, W, Hkv, D]``; ``seen``: ``[B]`` total
     stream length per session row."""
 

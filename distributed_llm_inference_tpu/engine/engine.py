@@ -84,6 +84,7 @@ class InferenceEngine:
             self.cache = PagedKVCache.create(
                 cfg.num_layers, b, cc.num_pages, cc.page_size,
                 cc.max_pages_per_session, cfg.num_kv_heads, cfg.head_dim, dtype,
+                use_kernel=self.ecfg.use_pallas_attention,
             )
             self.allocator = PageAllocator(cc.num_pages)
         elif cc.kind == "sink":

@@ -141,11 +141,10 @@ def _decoder_layer(
     k = k.reshape(b, s, hkv, d)
     v = v.reshape(b, s, hkv, d)
 
-    q_rot, k_all, v_all, mask, new_k, new_v = cache.update_and_gather(
+    attn, new_k, new_v = cache.attend(
         layer_k, layer_v, q, k, v, rope, q_pos, num_new,
-        sliding_window=cfg.sliding_window,
+        cfg.sliding_window, attention_fn, d**-0.5,
     )
-    attn = attention_fn(q_rot, k_all, v_all, mask, scale=d**-0.5)
     o = qmatmul(attn.reshape(b, s, hq * d), p["wo"])
     if "bo" in p:
         o = o + p["bo"]

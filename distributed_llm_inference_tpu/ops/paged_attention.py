@@ -1,0 +1,180 @@
+"""Pallas paged-attention kernel (decode hot path).
+
+The decode-side companion of ``flash_attention.py`` (SURVEY §7 step 4): at
+decode the XLA path first gathers every session's pages into a contiguous
+``[B, max_len, Hkv, D]`` view (``cache/paged.py:update_and_gather``) — a full
+copy of the active KV working set through HBM per layer per token. This kernel
+instead reads K/V **in place** from the page pool: the grid walks
+``(batch, kv-head, page)`` and the page table rides as a scalar-prefetch
+operand, so each step's K/V block is DMA'd straight from the physical page the
+table points at (the TPU analog of vLLM's paged attention; the reference's
+multi-tenancy never got past a dict of growing tensors,
+``/root/reference/distributed_llm_inference/models/llama/cache.py:14-19``).
+
+Two bandwidth savings over the gather path:
+* no materialized contiguous copy — pages stream through VMEM once;
+* page blocks past a row's live length are clamped to the null page 0 in the
+  index map, so short rows in a long-table batch fetch (cheap, cached)
+  zeros instead of the whole table span.
+
+GQA is folded as in the flash kernel: the ``G = Hq/Hkv`` query heads sharing
+one kv head form the matmul's row dimension. Online softmax state (running
+max / denominator / accumulator) lives in VMEM scratch carried across the
+page-grid axis (innermost ⇒ scratch persists across one row's page sweep).
+
+Runs in interpret mode off-TPU so the CPU test mesh exercises it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import _NEG_INF
+
+__all__ = ["paged_attention"]
+
+
+def _paged_kernel(
+    table_ref,  # SMEM [B, T] int32 (scalar prefetch)
+    len_ref,    # SMEM [B] int32 (scalar prefetch)
+    q_ref,      # [1, 1, G, D]
+    k_ref,      # [1, 1, PS, D]
+    v_ref,      # [1, 1, PS, D]
+    out_ref,    # [1, 1, G, D]
+    acc_ref,    # VMEM [G, D] f32
+    m_ref,      # VMEM [G, 128] f32
+    l_ref,      # VMEM [G, 128] f32
+    *,
+    scale: float,
+    page_size: int,
+    num_page_blocks: int,
+    sliding_window: Optional[int],
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    g, d = q_ref.shape[2], q_ref.shape[3]
+    kv_len = len_ref[b]
+
+    # Live-kv + sliding-window mask for this page's slots. Decode query sits
+    # at position kv_len - 1, so causality ≡ slot validity.
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )
+    valid = pos < kv_len
+    if sliding_window is not None:
+        valid &= pos > kv_len - 1 - sliding_window
+
+    q = q_ref[0, 0]    # [G, D]
+    k = k_ref[0, 0]    # [PS, D]
+    v = v_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                # [G, PS]
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == num_page_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Decode attention straight over the page pool.
+
+    ``q``: ``[B, 1, Hq, D]`` (already rotated); ``k_pages``/``v_pages``:
+    ``[P, Hkv, page_size, D]`` — one layer's pool, keys stored rotated;
+    ``page_table``: ``[B, T]`` int32 physical page ids (slot order = position
+    order, 0 = null page); ``kv_lengths``: ``[B]`` int32 live kv count per row
+    *including* the token written this step. Returns ``[B, 1, Hq, D]``.
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"paged_attention is decode-only (S=1), got S={s}")
+    _, hkv, page_size, _ = k_pages.shape
+    t = page_table.shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qr = q.reshape(b, hkv, g, d)  # kv-head-major grouping, as gqa_attention
+
+    def _page_index(bi, hi, ji, table, lens):
+        # Clamp blocks past the row's live span to the null page: the fetch
+        # still happens (BlockSpec semantics) but hits one hot page.
+        live = ji * page_size < lens[bi]
+        return (jnp.where(live, table[bi, ji], 0), hi, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, t),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda bi, hi, ji, table, lens: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), _page_index),
+            pl.BlockSpec((1, 1, page_size, d), _page_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bi, hi, ji, table, lens: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel,
+        scale=scale,
+        page_size=page_size,
+        num_page_blocks=t,
+        sliding_window=sliding_window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lengths.astype(jnp.int32),
+      qr, k_pages, v_pages)
+    return out.reshape(b, 1, hq, d)

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import ModelConfig
 from ..models import llama
 from ..ops.attention import _NEG_INF, causal_mask
+from ..cache.base import GatherAttendMixin
 from ..ops.rotary import apply_rope
 
 __all__ = ["ring_gqa_attention", "ring_prefill", "dense_cache_from_ring"]
@@ -106,7 +107,7 @@ def ring_gqa_attention(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sl, hq, d).astype(q.dtype)
 
 
-class RingChunkCache(struct.PyTreeNode):
+class RingChunkCache(GatherAttendMixin, struct.PyTreeNode):
     """Cache-protocol adapter for a sequence-sharded fresh prefill.
 
     Each ``sp`` device owns the chunk of global positions

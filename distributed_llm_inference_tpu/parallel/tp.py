@@ -125,10 +125,10 @@ def cache_pspecs(cache: Any, use_pp: bool = False) -> Any:
         kv = P(pp, "dp", None, "tp", None)
         return DenseKVCache(k=kv, v=kv, lengths=P("dp"))
     if isinstance(cache, PagedKVCache):
-        kv = P(pp, None, None, "tp", None)
+        kv = P(pp, None, "tp", None, None)
         return PagedKVCache(
             k_pages=kv, v_pages=kv, page_table=P("dp", None), lengths=P("dp"),
-            page_size=cache.page_size,
+            page_size=cache.page_size, use_kernel=cache.use_kernel,
         )
     if isinstance(cache, SinkKVCache):
         kv = P(pp, "dp", None, "tp", None)
