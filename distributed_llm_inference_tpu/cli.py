@@ -147,19 +147,28 @@ def cmd_local(args) -> int:
         EngineConfig(
             max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
             max_new_tokens=args.max_new, dtype=args.dtype,
-            quantization="int8" if args.int8 else None,
+            quantization=args.quantize or ("int8" if args.int8 else None),
         ),
         CacheConfig(kind=args.cache),
     )
     prompt = _parse_ids(args.prompt_ids)
     t0 = time.monotonic()
-    outs = engine.generate(
-        [prompt],
-        SamplingOptions(temperature=args.temperature,
-                        max_new_tokens=args.max_new,
-                        eos_token_id=args.eos if args.eos is not None else -1),
-    )
+    from .utils.tracing import profile_trace
+
+    with profile_trace(args.profile_dir):
+        outs = engine.generate(
+            [prompt],
+            SamplingOptions(temperature=args.temperature,
+                            max_new_tokens=args.max_new,
+                            eos_token_id=args.eos if args.eos is not None else -1),
+        )
     dt = time.monotonic() - t0
+    if args.profile_dir:
+        import os
+
+        engine.spans.dump_chrome_trace(
+            os.path.join(args.profile_dir, "host_spans.json")
+        )
     print(json.dumps({
         "event": "generated", "prompt": prompt, "tokens": outs[0],
         "seconds": round(dt, 3),
@@ -226,9 +235,13 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--cache", default="paged",
                    choices=("paged", "dense", "sink"))
     l.add_argument("--int8", action="store_true")
+    l.add_argument("--quantize", default=None, choices=("int8", "int4"))
     l.add_argument("--max-sessions", type=int, default=8)
     l.add_argument("--max-seq-len", type=int, default=2048)
     l.add_argument("--dtype", default="bfloat16")
+    l.add_argument("--profile-dir", default=None,
+                   help="dump a jax.profiler device trace + host span "
+                        "timeline (Perfetto-loadable) into this directory")
     l.set_defaults(fn=cmd_local)
 
     i = sub.add_parser("info", help="inspect a checkpoint")

@@ -163,7 +163,7 @@ class EngineConfig:
     max_seq_len: int = 4096
     max_new_tokens: int = 512
     dtype: str = "bfloat16"
-    quantization: Optional[str] = None  # None | "int8"
+    quantization: Optional[str] = None  # None | "int8" | "int4"
     use_pallas_attention: bool = False
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled

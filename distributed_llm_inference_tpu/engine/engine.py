@@ -37,6 +37,7 @@ from ..cache.sink import SinkKVCache
 from ..config import CacheConfig, EngineConfig, ModelConfig
 from ..models import llama
 from ..utils.metrics import Metrics
+from ..utils.tracing import SpanRecorder, span
 from .sampling import SamplingOptions, SamplingParams, sample
 from .session import Session, SessionState
 
@@ -60,16 +61,19 @@ class InferenceEngine:
     ):
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
-        if self.ecfg.quantization == "int8":
+        if self.ecfg.quantization in ("int8", "int4"):
             from ..ops.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(
+                params, bits=4 if self.ecfg.quantization == "int4" else 8
+            )
         elif self.ecfg.quantization is not None:
             raise ValueError(f"unknown quantization {self.ecfg.quantization!r}")
         self.params = params
         self.ccfg = cache_cfg or CacheConfig()
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics = Metrics()
+        self.spans = SpanRecorder()
 
         self.batch = self.ecfg.max_batch_size
         dtype = jnp.dtype(self.ecfg.dtype)
@@ -266,7 +270,10 @@ class InferenceEngine:
         chunk_cap = self._max_chunk()
         prompt = np.asarray(s.prompt, np.int32)
         offset = 0
-        with self.metrics.timer("prefill"):
+        with self.metrics.timer("prefill"), span(
+            "prefill", self.spans,
+            generation_id=s.generation_id, prompt_tokens=len(s.prompt),
+        ):
             while len(prompt) - offset > chunk_cap:
                 chunk = prompt[offset : offset + chunk_cap]
                 padded = jnp.asarray(chunk)[None, :]
@@ -332,7 +339,9 @@ class InferenceEngine:
             return
 
         sp = SamplingParams.stack(opts)
-        with self.metrics.timer("decode_step"):
+        with self.metrics.timer("decode_step"), span(
+            "decode_step", self.spans, batch=int(active.sum()),
+        ):
             next_tokens, self.cache = self._decode(
                 self.params, jnp.asarray(tokens), self.cache,
                 jnp.asarray(active), self._next_key(), sp,

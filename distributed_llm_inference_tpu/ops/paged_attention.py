@@ -5,22 +5,26 @@ decode the XLA path first gathers every session's pages into a contiguous
 ``[B, max_len, Hkv, D]`` view (``cache/paged.py:update_and_gather``) — a full
 copy of the active KV working set through HBM per layer per token. This kernel
 instead reads K/V **in place** from the page pool: the grid walks
-``(batch, kv-head, page)`` and the page table rides as a scalar-prefetch
-operand, so each step's K/V block is DMA'd straight from the physical page the
-table points at (the TPU analog of vLLM's paged attention; the reference's
-multi-tenancy never got past a dict of growing tensors,
+``(batch, page)`` with the page table riding as a scalar-prefetch operand, so
+each step DMAs one whole physical page (all KV heads — ``[Hkv, PS, D]``, a
+megabyte-scale contiguous block) straight from where it lives (the TPU analog
+of vLLM's paged attention; the reference's multi-tenancy never got past a
+dict of growing tensors,
 ``/root/reference/distributed_llm_inference/models/llama/cache.py:14-19``).
 
-Two bandwidth savings over the gather path:
+Bandwidth properties:
 * no materialized contiguous copy — pages stream through VMEM once;
 * page blocks past a row's live length are clamped to the null page 0 in the
-  index map, so short rows in a long-table batch fetch (cheap, cached)
-  zeros instead of the whole table span.
+  index map, so short rows in a long-table batch fetch (cheap, cached) zeros
+  instead of the whole table span — the dense cache by contrast always reads
+  its full padded buffer;
+* MHA (``G == 1``) uses a VPU multiply-reduce for QK^T and PV — a 1-row MXU
+  matmul per head wastes the systolic array; GQA (``G > 1``) uses
+  ``Hkv``-batched ``dot_general``.
 
-GQA is folded as in the flash kernel: the ``G = Hq/Hkv`` query heads sharing
-one kv head form the matmul's row dimension. Online softmax state (running
-max / denominator / accumulator) lives in VMEM scratch carried across the
-page-grid axis (innermost ⇒ scratch persists across one row's page sweep).
+Online-softmax state (running max / denominator / accumulator) lives in VMEM
+scratch carried across the page-grid axis (innermost ⇒ scratch persists
+across one row's page sweep).
 
 Runs in interpret mode off-TPU so the CPU test mesh exercises it.
 """
@@ -43,21 +47,23 @@ __all__ = ["paged_attention"]
 def _paged_kernel(
     table_ref,  # SMEM [B, T] int32 (scalar prefetch)
     len_ref,    # SMEM [B] int32 (scalar prefetch)
-    q_ref,      # [1, 1, G, D]
-    k_ref,      # [1, 1, PS, D]
-    v_ref,      # [1, 1, PS, D]
-    out_ref,    # [1, 1, G, D]
-    acc_ref,    # VMEM [G, D] f32
-    m_ref,      # VMEM [G, 128] f32
-    l_ref,      # VMEM [G, 128] f32
+    q_ref,      # [1, Hkv, G, D]
+    k_ref,      # [1, Hkv, PS, D]
+    v_ref,      # [1, Hkv, PS, D]
+    out_ref,    # [1, Hkv, G, D]
+    acc_ref,    # VMEM [Hkv*G, D] f32
+    m_ref,      # VMEM [Hkv*G, 128] f32
+    l_ref,      # VMEM [Hkv*G, 128] f32
     *,
     scale: float,
     page_size: int,
     num_page_blocks: int,
     sliding_window: Optional[int],
+    hkv: int,
+    g: int,
 ):
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -65,7 +71,6 @@ def _paged_kernel(
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    g, d = q_ref.shape[2], q_ref.shape[3]
     kv_len = len_ref[b]
 
     # Live-kv + sliding-window mask for this page's slots. Decode query sits
@@ -77,13 +82,21 @@ def _paged_kernel(
     if sliding_window is not None:
         valid &= pos > kv_len - 1 - sliding_window
 
-    q = q_ref[0, 0]    # [G, D]
-    k = k_ref[0, 0]    # [PS, D]
-    v = v_ref[0, 0]
+    q = q_ref[0]  # [Hkv, G, D]
+    k = k_ref[0]  # [Hkv, PS, D]
+    v = v_ref[0]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                # [G, PS]
+    if g == 1:
+        # MHA: VPU multiply-reduce; a [1, D] x [D, PS] MXU call per head
+        # would waste the systolic array on 1-row matmuls.
+        qv = q[:, 0, :][:, None, :].astype(jnp.float32)     # [Hkv, 1, D]
+        s = jnp.sum(qv * k.astype(jnp.float32), axis=-1)    # [Hkv, PS]
+    else:
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(hkv * g, page_size)                        # [Hkv*G, PS]
+    s = s * scale
     s = jnp.where(valid, s, _NEG_INF)
 
     m_prev = m_ref[:, :1]
@@ -97,16 +110,24 @@ def _paged_kernel(
         alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+
+    if g == 1:
+        pv = jnp.sum(p[:, :, None] * v.astype(jnp.float32), axis=1)  # [Hkv, D]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+    else:
+        pg = p.reshape(hkv, g, page_size).astype(v.dtype)
+        pv = jax.lax.dot_general(
+            pg, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(hkv * g, -1)
 
     @pl.when(j == num_page_blocks - 1)
     def _finalize():
+        # Fully-masked rows (kv_len == 0) have l == 0 → emit zeros.
         l = l_ref[:, :1]
         out = acc_ref[:] / jnp.maximum(l, 1e-20)
-        out_ref[0, 0] = out.astype(out_ref.dtype)
+        out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
 
 
 def paged_attention(
@@ -140,27 +161,27 @@ def paged_attention(
 
     qr = q.reshape(b, hkv, g, d)  # kv-head-major grouping, as gqa_attention
 
-    def _page_index(bi, hi, ji, table, lens):
+    def _page_index(bi, ji, table, lens):
         # Clamp blocks past the row's live span to the null page: the fetch
         # still happens (BlockSpec semantics) but hits one hot page.
         live = ji * page_size < lens[bi]
-        return (jnp.where(live, table[bi, ji], 0), hi, 0, 0)
+        return (jnp.where(live, table[bi, ji], 0), 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, hkv, t),
+        grid=(b, t),
         in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda bi, hi, ji, table, lens: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), _page_index),
-            pl.BlockSpec((1, 1, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, g, d), lambda bi, ji, table, lens: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, g, d), lambda bi, hi, ji, table, lens: (bi, hi, 0, 0)
+            (1, hkv, g, d), lambda bi, ji, table, lens: (bi, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((g, d), jnp.float32),
-            pltpu.VMEM((g, 128), jnp.float32),
-            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((hkv * g, d), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
         ],
     )
     kernel = functools.partial(
@@ -169,6 +190,8 @@ def paged_attention(
         page_size=page_size,
         num_page_blocks=t,
         sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
     )
     out = pl.pallas_call(
         kernel,

@@ -1,11 +1,12 @@
-"""Weight-only int8 quantization for bandwidth-bound decode.
+"""Weight-only int8 / int4 quantization for bandwidth-bound decode.
 
 TPU-native replacement for the reference's bitsandbytes ``Linear8bitLt`` swap
 (``/root/reference/distributed_llm_inference/utils/model.py:93-123``, CUDA-only
 guard at ``:117-118``). Instead of a module-tree surgery, quantization is a
 pytree transform: each projection matrix becomes a :class:`QuantizedTensor`
-(int8 values + per-output-channel fp scales), and the matmul helper
-dequantizes in-kernel.
+(int8 values + per-output-channel fp scales) or :class:`QuantizedTensor4`
+(int4 values + per-(input-group, output-channel) scales), and the matmul
+helper dequantizes in-kernel.
 
 Why weight-only symmetric int8: decode is HBM-bandwidth-bound (the whole
 weight set is read once per token), so halving weight bytes ≈ doubles decode
@@ -14,10 +15,18 @@ throughput and frees HBM for larger batches; XLA fuses the
 extra memory pass. A true int8×int8 MXU path (dynamic per-token activation
 scales, AQT-style) is the prefill compute optimization — weight-only keeps
 activations in bf16 and loses no MXU throughput at decode shapes.
+
+int4 halves weight bytes again (XLA packs two ``s4`` values per byte on TPU)
+at the cost of per-group scales: a per-output-channel scale alone is too
+coarse at 4 bits, so the input dimension is split into groups of
+``group_size`` (AWQ/GPTQ-style) and each (group, out-channel) pair gets its
+own scale; the matmul computes per-group partial sums and scales them before
+reduction, keeping the int4→bf16 convert fused into the operand read.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, Optional
 
 import jax
@@ -26,10 +35,13 @@ from flax import struct
 
 __all__ = [
     "QuantizedTensor",
+    "QuantizedTensor4",
     "quantize_int8",
+    "quantize_int4",
     "matmul",
     "quantize_params",
     "QUANTIZED_WEIGHTS",
+    "INT4_WEIGHTS",
 ]
 
 # Layer-stack weights worth quantizing (the large matmuls). Norm gains and
@@ -39,6 +51,9 @@ QUANTIZED_WEIGHTS = (
     "we_g", "we_u", "we_d",                    # MoE experts
     "lm_head",
 )
+
+# Weights eligible for group-wise int4 (plain ``x @ w`` projections).
+INT4_WEIGHTS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "lm_head")
 
 
 class QuantizedTensor(struct.PyTreeNode):
@@ -58,6 +73,45 @@ class QuantizedTensor(struct.PyTreeNode):
         return self.scale.dtype
 
 
+class QuantizedTensor4(struct.PyTreeNode):
+    """int4 weight with per-(input-group, output-channel) scales.
+
+    ``q``: **nibble-packed int8** ``[..., G, group_size, out // 2]`` — two
+    adjacent output channels per byte (even channel in the low nibble). The
+    int8 container keeps the pytree leaf a universally supported dtype (the
+    tunneled TPU platform can't transfer ``s4`` arrays across the jit
+    boundary); :func:`matmul` reinterprets it in-graph via
+    ``lax.bitcast_convert_type`` to ``int4``, which XLA fuses (bitcast +
+    convert) into the matmul operand read — HBM traffic is the packed half
+    byte per value. ``scale``: fp ``[..., G, out]``. ``shape`` reports the
+    logical ``[..., in, out]``.
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        *lead, g, gs, out_packed = self.q.shape
+        return (*lead, g * gs, out_packed * 2)
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+    def unpack(self) -> jax.Array:
+        """In-graph int4 view ``[..., G, gs, out]`` (low nibble = even
+        channel; bitcast appends a trailing pair axis).
+
+        CAUTION: always pass the tensor INTO jit as an argument — a
+        closure-captured (constant-folded) ``bitcast_convert_type`` to int4
+        miscompiles on XLA:CPU (observed jax 0.9.0); as a traced argument it
+        is correct on both CPU and TPU."""
+        *lead, g, gs, out_packed = self.q.shape
+        q4 = jax.lax.bitcast_convert_type(self.q, jnp.int4)
+        return q4.reshape(*lead, g, gs, out_packed * 2)
+
+
 def quantize_int8(w: jax.Array, scale_dtype=jnp.bfloat16) -> QuantizedTensor:
     """Symmetric per-output-channel int8 quantization of ``[..., in, out]``."""
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
@@ -68,15 +122,64 @@ def quantize_int8(w: jax.Array, scale_dtype=jnp.bfloat16) -> QuantizedTensor:
     return QuantizedTensor(q=q, scale=scale.squeeze(-2).astype(scale_dtype))
 
 
+def quantize_int4(
+    w: jax.Array, group_size: Optional[int] = 128, scale_dtype=jnp.bfloat16
+) -> QuantizedTensor4:
+    """Symmetric group-wise int4 quantization of ``[..., in, out]``.
+
+    ``in`` must be divisible by ``group_size`` (true for every transformer
+    projection at real model shapes; pad otherwise before calling).
+    ``group_size=None`` uses one group (per-output-channel scales only):
+    fastest decode (a single ungrouped matmul) but coarser quantization —
+    prefer grouped scales for accuracy-sensitive serving.
+    """
+    *lead, in_dim, out = w.shape
+    if group_size is None:
+        group_size = in_dim
+    if in_dim % group_size:
+        raise ValueError(f"in dim {in_dim} not divisible by group {group_size}")
+    if out % 2:
+        raise ValueError(f"out dim {out} must be even (nibble packing)")
+    g = in_dim // group_size
+    wf = w.astype(jnp.float32).reshape(*lead, g, group_size, out)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # [..., G, 1, out]
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int8)
+    # Pack adjacent output channels: even → low nibble, odd → high nibble
+    # (matches the little-endian pair order of bitcast int8 → int4[..., 2]).
+    lo = jnp.bitwise_and(q[..., 0::2], jnp.int8(0x0F))
+    hi = jnp.left_shift(q[..., 1::2], jnp.int8(4))
+    return QuantizedTensor4(
+        q=jnp.bitwise_or(lo, hi), scale=scale.squeeze(-2).astype(scale_dtype)
+    )
+
+
 def matmul(x: jax.Array, w) -> jax.Array:
     """``x @ w`` that transparently handles quantized weights.
 
     For a :class:`QuantizedTensor`, computes ``(x @ q) * scale`` with the
-    int8→bf16 convert fused into the matmul operand read by XLA.
+    int8→bf16 convert fused into the matmul operand read by XLA. For a
+    :class:`QuantizedTensor4`, per-group partial sums are scaled before the
+    group reduction.
     """
     if isinstance(w, QuantizedTensor):
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(x.dtype)
+    if isinstance(w, QuantizedTensor4):
+        g, gs, outp = w.q.shape[-3:]
+        # Contract over the bitcast layout DIRECTLY — reshaping the s4 view
+        # to [in, out] first makes XLA materialize it (measured 3x slower at
+        # Llama-7B decode shapes); with the pair axis kept, the bitcast +
+        # convert fuse into the matmul operand read.
+        q4 = jax.lax.bitcast_convert_type(w.q, jnp.int4)  # [..., G, gs, outp, 2]
+        xg = x.reshape(*x.shape[:-1], g, gs)
+        part = jnp.einsum(
+            "...gi,giop->...gop", xg, q4.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        sc = w.scale.reshape(*w.scale.shape[:-1], outp, 2).astype(jnp.float32)
+        y = jnp.sum(part * sc, axis=-3)  # reduce groups
+        return y.reshape(*y.shape[:-2], outp * 2).astype(x.dtype)
     return x @ w
 
 
@@ -94,19 +197,38 @@ def einsum(spec: str, x: jax.Array, w) -> jax.Array:
 
 
 def quantize_params(
-    params: Dict[str, Any], names=QUANTIZED_WEIGHTS, scale_dtype=jnp.bfloat16
+    params: Dict[str, Any],
+    names=QUANTIZED_WEIGHTS,
+    scale_dtype=jnp.bfloat16,
+    bits: int = 8,
+    group_size: int = 128,
 ) -> Dict[str, Any]:
     """Quantize the named weights in a param pytree (full-model or block-only);
-    everything else passes through unchanged."""
+    everything else passes through unchanged.
+
+    ``bits=4`` uses group-wise int4 for the dense projections
+    (:data:`INT4_WEIGHTS`); MoE expert stacks stay int8 (the ``einsum``
+    helper's scale broadcast doesn't cover grouped contraction). The group
+    size degrades to ``gcd(group_size, in_dim)`` so small test shapes divide.
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+
+    def quantize_one(name, w):
+        if bits == 4 and name in INT4_WEIGHTS and w.shape[-1] % 2 == 0:
+            gs = math.gcd(group_size, w.shape[-2])
+            return quantize_int4(w, gs, scale_dtype)
+        return quantize_int8(w, scale_dtype)
+
     out: Dict[str, Any] = {}
     for k, v in params.items():
         if k == "layers":
             out[k] = {
-                n: quantize_int8(w, scale_dtype) if n in names else w
+                n: quantize_one(n, w) if n in names else w
                 for n, w in v.items()
             }
         elif k in names:
-            out[k] = quantize_int8(v, scale_dtype)
+            out[k] = quantize_one(k, v)
         else:
             out[k] = v
     return out

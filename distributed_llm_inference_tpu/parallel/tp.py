@@ -84,11 +84,18 @@ def layer_pspecs(use_pp: bool = False) -> Dict[str, P]:
 def _maybe_qspec(param: Any, spec: P) -> Any:
     """Weight spec → spec pytree; quantized weights need a matching
     :class:`QuantizedTensor` node whose per-output-channel scale drops the
-    contracted (second-to-last) axis of the weight spec."""
-    from ..ops.quant import QuantizedTensor
+    contracted (second-to-last) axis of the weight spec. int4 grouped
+    weights ``[..., G, gs, out]`` carry the contracted axis's sharding on the
+    group axis (whole groups per device), replicating within a group."""
+    from ..ops.quant import QuantizedTensor, QuantizedTensor4
 
     if isinstance(param, QuantizedTensor):
         return QuantizedTensor(q=spec, scale=P(*spec[:-2], spec[-1]))
+    if isinstance(param, QuantizedTensor4):
+        return QuantizedTensor4(
+            q=P(*spec[:-2], spec[-2], None, spec[-1]),
+            scale=P(*spec[:-2], spec[-2], spec[-1]),
+        )
     return spec
 
 

@@ -1,0 +1,167 @@
+"""Tracing / profiling (SURVEY §5.1 — absent in the reference).
+
+The reference has no profiler hooks at all (its only observability is two
+``print`` statements, ``/root/reference/distributed_llm_inference/utils/
+model.py:61,82``). This module supplies the two tiers the TPU rebuild needs:
+
+* **Device profiling** — :func:`profile_trace` / :func:`start_profile` wrap
+  ``jax.profiler`` so a serving window dumps an XLA trace (TensorBoard /
+  Perfetto-viewable) with the engine's step names attached via
+  ``jax.profiler.TraceAnnotation``.
+* **Host spans** — :class:`SpanRecorder` records named wall-clock spans
+  (per-request prefill/decode/queue segments) and exports standard Chrome
+  trace-event JSON (``chrome://tracing`` / Perfetto load it directly), so
+  request-level timelines exist even off-TPU and without the profiler
+  running.
+
+Both tiers are cheap no-ops when idle: ``span`` costs two ``perf_counter``
+calls when no profiler is active, and the recorder is bounded.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "span",
+    "profile_trace",
+    "start_profile",
+    "stop_profile",
+]
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float  # perf_counter timestamp
+    duration_s: float
+    args: Optional[Dict[str, Any]] = None
+
+
+class SpanRecorder:
+    """Bounded, thread-safe span log with Chrome trace-event export.
+
+    The engine's host threads (SURVEY §5.2's concurrency caution) may record
+    concurrently; the newest ``capacity`` spans are kept.
+    """
+
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # deque(maxlen): O(1) append-with-evict — record() sits on the
+        # per-decode-step hot path.
+        self._spans: collections.deque[Span] = collections.deque(maxlen=capacity)
+
+    def record(self, s: Span) -> None:
+        with self._lock:
+            self._spans.append(s)
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def chrome_trace(self) -> Dict:
+        """Chrome trace-event JSON object (load in Perfetto / about:tracing)."""
+        events = []
+        for s in self.spans():
+            ev = {
+                "name": s.name,
+                "ph": "X",  # complete event
+                "ts": s.start_s * 1e6,  # microseconds
+                "dur": s.duration_s * 1e6,
+                "pid": 0,
+                "tid": 0,
+            }
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    recorder: Optional[SpanRecorder] = None,
+    **args: Any,
+) -> Iterator[None]:
+    """Time a host-side region; annotate any device work launched inside it.
+
+    ``TraceAnnotation`` threads ``name`` into the XLA profiler timeline when a
+    device trace is running (so engine steps show up named in the Perfetto
+    dump); the wall-clock span goes to ``recorder`` if given.
+    """
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        # Record even when the region raises — the failing/slow step is
+        # exactly the one worth having on the timeline.
+        if recorder is not None:
+            recorder.record(
+                Span(name, t0, time.perf_counter() - t0, args or None)
+            )
+
+
+_profile_lock = threading.Lock()
+_profile_dir: Optional[str] = None
+
+
+def start_profile(log_dir: str) -> bool:
+    """Begin a ``jax.profiler`` device trace into ``log_dir``. Returns True
+    when this call started the trace; False when one was already running (the
+    running trace is left untouched)."""
+    global _profile_dir
+    with _profile_lock:
+        if _profile_dir is not None:
+            return False
+        jax.profiler.start_trace(log_dir)
+        _profile_dir = log_dir
+        return True
+
+
+def stop_profile() -> Optional[str]:
+    """Stop the running device trace; returns its log dir (None if idle)."""
+    global _profile_dir
+    with _profile_lock:
+        if _profile_dir is None:
+            return None
+        out, _profile_dir = _profile_dir, None
+        jax.profiler.stop_trace()
+        return out
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Profile the enclosed region into ``log_dir`` (no-op when None).
+
+    Only stops a trace this context actually started — nesting inside an
+    externally started ``start_profile`` window leaves that trace running.
+    """
+    if log_dir is None:
+        yield
+        return
+    started = start_profile(log_dir)
+    try:
+        yield
+    finally:
+        if started:
+            stop_profile()

@@ -162,3 +162,113 @@ def test_engine_rejects_unknown_quantization():
         InferenceEngine(
             CFG, params, EngineConfig(quantization="fp4"), CacheConfig(kind="dense")
         )
+
+
+# ---------------------------------------------------------------------------
+# int4 (group-wise) quantization
+# ---------------------------------------------------------------------------
+
+from distributed_llm_inference_tpu.ops.quant import (  # noqa: E402
+    QuantizedTensor4,
+    quantize_int4,
+)
+
+
+def test_int4_roundtrip_error():
+    w = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+    qt = quantize_int4(jnp.asarray(w), group_size=16, scale_dtype=jnp.float32)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == (4, 16, 16)  # packed
+    assert qt.scale.shape == (4, 32)
+    assert qt.shape == (64, 32)
+    unpacked = np.asarray(jax.jit(lambda t: t.unpack())(qt), np.float32)
+    assert unpacked.shape == (4, 16, 32)
+    deq = unpacked * np.asarray(qt.scale)[:, None, :]
+    err = np.abs(deq.reshape(64, 32) - w)
+    bound = np.repeat(np.asarray(qt.scale), 16, axis=0) * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_int4_matmul_close():
+    r = np.random.RandomState(1)
+    x = r.randn(4, 64).astype(np.float32)
+    w = r.randn(64, 32).astype(np.float32)
+    qt = quantize_int4(jnp.asarray(w), group_size=16, scale_dtype=jnp.float32)
+    out = np.asarray(matmul(jnp.asarray(x), qt))
+    # Exact vs the dequantized weights (the matmul itself adds no error) …
+    deq = np.asarray(jax.jit(lambda t: t.unpack())(qt), np.float32) * np.asarray(qt.scale)[:, None, :]
+    np.testing.assert_allclose(out, x @ deq.reshape(64, 32), atol=1e-4, rtol=1e-4)
+    # … and within int4 noise of the fp32 product (random N(0,1) weights are
+    # the worst case; real LLM weights fare much better).
+    ref = x @ w
+    rel = np.abs(out - ref) / (np.abs(ref) + 1.0)
+    assert rel.mean() < 0.2, rel.mean()
+
+
+def test_int4_model_logits_close_and_structure():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, scale_dtype=jnp.float32, bits=4, group_size=16)
+    assert isinstance(qparams["layers"]["wq"], QuantizedTensor4)
+    assert isinstance(qparams["lm_head"], QuantizedTensor4)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size)
+    n = jnp.full((2,), 8, jnp.int32)
+    mk = lambda: DenseKVCache.create(
+        CFG.num_layers, 2, 16, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+    )
+    ref, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+        params, tokens, mk()
+    )
+    out, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+        qparams, tokens, mk()
+    )
+    ref, out = np.asarray(ref), np.asarray(out)
+    cos = (ref * out).sum() / (np.linalg.norm(ref) * np.linalg.norm(out))
+    assert cos > 0.99, cos
+
+
+def test_int4_moe_experts_fall_back_to_int8():
+    mcfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=64,
+        num_experts=4, num_experts_per_tok=2, family="mixtral",
+    )
+    params = llama.init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, scale_dtype=jnp.float32, bits=4, group_size=16)
+    assert isinstance(qparams["layers"]["we_g"], QuantizedTensor)
+    assert isinstance(qparams["layers"]["wq"], QuantizedTensor4)
+
+
+def test_int4_sharded_matches_single_device():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params, scale_dtype=jnp.float32, bits=4, group_size=16)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size)
+    n = jnp.full((2,), 8, jnp.int32)
+    mk = lambda: DenseKVCache.create(
+        CFG.num_layers, 2, 16, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+    )
+    ref, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+        qparams, tokens, mk()
+    )
+    mesh = build_mesh(MeshConfig(tp=2))
+    sp = shard_pytree(qparams, mesh, param_pspecs(qparams))
+    sc = shard_pytree(mk(), mesh, cache_pspecs(mk()))
+    with mesh:
+        out, _ = jax.jit(lambda p, t, c: llama.model_apply(CFG, p, t, c, n))(
+            sp, tokens, sc
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_engine_int4_generates():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(
+            max_batch_size=2, prefill_buckets=(16,), max_seq_len=32,
+            max_new_tokens=5, quantization="int4",
+        ),
+        CacheConfig(kind="dense"),
+    )
+    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor4)
+    outs = eng.generate([[1, 2, 3]], SamplingOptions(temperature=0.0, max_new_tokens=5))
+    assert len(outs[0]) == 5

@@ -1,0 +1,103 @@
+"""Tracing/profiling subsystem (SURVEY §5.1).
+
+Host spans must capture engine step timing and export valid Chrome
+trace-event JSON; the jax.profiler wrapper must produce a trace dump and be
+idempotent/no-op-safe.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig, ModelConfig
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.utils import tracing
+
+
+def test_span_records_and_exports(tmp_path):
+    rec = tracing.SpanRecorder()
+    with tracing.span("work", rec, items=3):
+        pass
+    with tracing.span("unrecorded"):
+        pass
+    spans = rec.spans()
+    assert [s.name for s in spans] == ["work"]
+    assert spans[0].duration_s >= 0
+    assert spans[0].args == {"items": 3}
+
+    path = tmp_path / "trace.json"
+    rec.dump_chrome_trace(str(path))
+    doc = json.loads(path.read_text())
+    assert doc["traceEvents"][0]["name"] == "work"
+    assert doc["traceEvents"][0]["ph"] == "X"
+    assert doc["traceEvents"][0]["dur"] >= 0
+
+
+def test_span_recorder_bounded_and_thread_safe():
+    rec = tracing.SpanRecorder(capacity=64)
+
+    def worker(i):
+        for j in range(50):
+            rec.record(tracing.Span(f"t{i}.{j}", 0.0, 0.001))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(rec.spans()) == 64  # bounded, no crash
+
+
+def test_profile_trace_writes_device_trace(tmp_path):
+    d = str(tmp_path / "prof")
+    with tracing.profile_trace(d):
+        jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+    files = [str(p) for p in (tmp_path / "prof").rglob("*")]
+    assert any("trace" in f or f.endswith(".pb") or f.endswith(".json.gz")
+               for f in files), files
+    # No-op and double-stop safety.
+    with tracing.profile_trace(None):
+        pass
+    assert tracing.stop_profile() is None
+
+
+def test_engine_records_prefill_and_decode_spans():
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, num_kv_heads=2, head_dim=16,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = InferenceEngine(
+        cfg, params,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8,), max_seq_len=32,
+                     dtype="float32"),
+        CacheConfig(kind="dense"),
+    )
+    eng.generate([[1, 2, 3]], SamplingOptions(max_new_tokens=4))
+    names = {s.name for s in eng.spans.spans()}
+    assert "prefill" in names and "decode_step" in names
+    pre = next(s for s in eng.spans.spans() if s.name == "prefill")
+    assert pre.args["prompt_tokens"] == 3
+
+
+def test_span_recorded_on_exception():
+    rec = tracing.SpanRecorder()
+    try:
+        with tracing.span("boom", rec):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert [s.name for s in rec.spans()] == ["boom"]
+
+
+def test_nested_profile_trace_keeps_outer(tmp_path):
+    outer = str(tmp_path / "outer")
+    assert tracing.start_profile(outer) is True
+    with tracing.profile_trace(str(tmp_path / "inner")):
+        pass  # must NOT stop the outer trace
+    assert tracing.stop_profile() == outer  # outer still owned + running
