@@ -16,7 +16,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_llm_inference_tpu.cache.dense import DenseKVCache
+from distributed_llm_inference_tpu.cache.dense import (
+    DenseKVCache,
+    QuantizedDenseKVCache,
+)
 from distributed_llm_inference_tpu.config import ModelConfig
 from distributed_llm_inference_tpu.models import llama
 from distributed_llm_inference_tpu.ops.quant import (
@@ -116,9 +119,9 @@ def _zero_q4params(cfg: ModelConfig):
     return _zero_tree(cfg, INT4_WEIGHTS, leaf)
 
 
-def _try_decode_bench(cfg, params, batch, ctx, steps=32):
+def _try_decode_bench(cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache):
     """Decode throughput at ``batch``: tokens/sec on this one chip."""
-    cache = DenseKVCache.create(
+    cache = cache_cls.create(
         cfg.num_layers, batch, ctx, cfg.num_kv_heads, cfg.head_dim
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
@@ -142,9 +145,9 @@ def _try_decode_bench(cfg, params, batch, ctx, steps=32):
     return batch * steps / dt
 
 
-def _ttft_bench(cfg, params, prompt_len=128, reps=5):
+def _ttft_bench(cfg, params, prompt_len=128, reps=5, cache_cls=DenseKVCache):
     """p50 time-to-first-token at bs=1 (prefill + argmax sample), ms."""
-    cache = DenseKVCache.create(
+    cache = cache_cls.create(
         cfg.num_layers, 1, prompt_len + 8, cfg.num_kv_heads, cfg.head_dim
     )
     num_new = jnp.full((1,), prompt_len, jnp.int32)
@@ -164,12 +167,15 @@ def _ttft_bench(cfg, params, prompt_len=128, reps=5):
     return float(np.percentile(times, 50))
 
 
-def _decode_ladder(cfg, params, ladder):
+def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
     """Largest-batch decode throughput that fits; ``(tok_s, batch)``."""
     err = None
     for batch, ctx in ladder:
         try:
-            return _try_decode_bench(cfg, params, batch, ctx), batch
+            return (
+                _try_decode_bench(cfg, params, batch, ctx, cache_cls=cache_cls),
+                batch,
+            )
         except Exception as e:  # OOM on the tight 7B-in-16GB fit
             # repr, not the exception: a held traceback pins the failed
             # attempt's device buffers and starves the smaller-batch retry.
@@ -178,25 +184,32 @@ def _decode_ladder(cfg, params, ladder):
     raise RuntimeError(f"all decode configs failed: {err}")
 
 
-# Weight config → (param builder, decode batch ladder). Each phase runs in
-# its own SUBPROCESS: the 7B-in-16GB fits are tight enough that a prior
-# phase's allocator state (fragmentation + anything an OOMed attempt left
-# pinned) starves the next phase even after jax.clear_caches().
+# Weight config → (param builder, decode batch ladder, KV cache class).
+# Each phase runs in its own SUBPROCESS: the 7B-in-16GB fits are tight enough
+# that a prior phase's allocator state (fragmentation + anything an OOMed
+# attempt left pinned) starves the next phase even after jax.clear_caches().
 PHASES = {
-    "bf16": (_zero_params, ((8, 256), (4, 256), (2, 256), (1, 256))),
-    "int8": (_zero_qparams, ((32, 256), (16, 256), (8, 256), (1, 256))),
-    "int4": (_zero_q4params, ((64, 256), (32, 256), (16, 256), (1, 256))),
+    "bf16": (_zero_params, ((8, 256), (4, 256), (2, 256), (1, 256)),
+             DenseKVCache),
+    "int8": (_zero_qparams, ((32, 256), (16, 256), (8, 256), (1, 256)),
+             DenseKVCache),
+    "int4": (_zero_q4params, ((64, 256), (32, 256), (16, 256), (1, 256)),
+             DenseKVCache),
+    # int8 weights + int8 KV (per-token/head scales): the KV working set
+    # dominates HBM traffic at large batch, so halving it moves the headline.
+    "int8_kvq": (_zero_qparams, ((80, 256), (64, 256), (32, 256), (1, 256)),
+                 QuantizedDenseKVCache),
 }
 
 
 def run_phase(name: str) -> dict:
     on_tpu = jax.default_backend() == "tpu"
     cfg = LLAMA2_7B if on_tpu else TINY
-    build, ladder = PHASES[name]
+    build, ladder, cache_cls = PHASES[name]
     params = build(cfg)
     jax.block_until_ready(params)
-    tok_s, batch = _decode_ladder(cfg, params, ladder)
-    ttft = _ttft_bench(cfg, params)
+    tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)
+    ttft = _ttft_bench(cfg, params, cache_cls=cache_cls)
     return {
         "tok_s": round(tok_s, 2), "batch": batch, "ttft_ms": round(ttft, 2),
         "backend": jax.default_backend(),
@@ -234,19 +247,23 @@ def main():
         return
 
     # Phases run in subprocesses; jax stays UNinitialized in this parent so
-    # children get the chip. Falls back to in-process (marked) only if
-    # isolation itself is unavailable.
+    # children get the chip. In-process fallbacks run only AFTER every
+    # subprocess attempt — initializing the runtime here mid-loop would
+    # demote the remaining children to CPU (see _phase_in_subprocess).
     results = {}
+    failed = {}
     for name in PHASES:
         try:
             results[name] = _phase_in_subprocess(name)
         except Exception as sub_err:
-            try:
-                results[name] = run_phase(name)
-                results[name]["isolation"] = "in-process"
-            except Exception as e:
-                results[name] = {"tok_s": 0.0, "batch": 0, "ttft_ms": None,
-                                 "error": f"{repr(sub_err)[:150]}; {repr(e)[:150]}"}
+            failed[name] = repr(sub_err)[:150]
+    for name, sub_err in failed.items():
+        try:
+            results[name] = run_phase(name)
+            results[name]["isolation"] = "in-process"
+        except Exception as e:
+            results[name] = {"tok_s": 0.0, "batch": 0, "ttft_ms": None,
+                             "error": f"{sub_err}; {repr(e)[:150]}"}
 
     best_dtype = max(results, key=lambda n: results[n]["tok_s"])
     best = results[best_dtype]

@@ -1,17 +1,24 @@
-"""Shared cache behavior: the ``attend`` step.
+"""Shared cache behavior: the layer-state protocol and the ``attend`` step.
 
-``attend`` is the single entry the model layer calls per decoder layer
-(``models/llama.py:_decoder_layer``): write the new k/v into the cache, run
-attention, return ``(attn_out, new_layer_k, new_layer_v)``. The default
-implementation is the always-correct XLA path — ``update_and_gather`` into a
-contiguous view, then the caller-supplied ``attention_fn``. Cache policies
-override it to fuse cache reads into a kernel (``PagedKVCache`` +
-``ops/paged_attention.py`` reads pages in place at decode).
+Every cache policy exposes its per-layer device state as a TUPLE of stacked
+arrays (leading axis = layer): ``layer_stacks`` / ``with_layer_stacks``. The
+model's scan (``models/llama.py:block_apply``) slices one layer's entry from
+each stack and hands the tuple to ``attend``; the tuple shape lets cache
+policies carry more than raw K/V — the int8-quantized cache threads
+per-token/head scale planes alongside the value planes.
+
+``attend`` is the single entry the model layer calls per decoder layer:
+write the new k/v into the cache, run attention, return
+``(attn_out, new_layer_state)``. The default implementation is the
+always-correct XLA path — ``update_and_gather`` into a contiguous view, then
+the caller-supplied ``attention_fn``. Cache policies override it to fuse
+cache reads into a kernel (``PagedKVCache`` + ``ops/paged_attention.py``
+reads pages in place at decode).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 
 class GatherAttendMixin:
@@ -19,8 +26,7 @@ class GatherAttendMixin:
 
     def attend(
         self,
-        layer_k,
-        layer_v,
+        layer_state: Tuple,
         q,
         k_new,
         v_new,
@@ -31,8 +37,8 @@ class GatherAttendMixin:
         attention_fn,
         scale: Optional[float] = None,
     ):
-        q_rot, k_all, v_all, mask, new_k, new_v = self.update_and_gather(
-            layer_k, layer_v, q, k_new, v_new, rope, q_pos, num_new,
+        q_rot, k_all, v_all, mask, new_state = self.update_and_gather(
+            layer_state, q, k_new, v_new, rope, q_pos, num_new,
             sliding_window=sliding_window,
         )
-        return attention_fn(q_rot, k_all, v_all, mask, scale=scale), new_k, new_v
+        return attention_fn(q_rot, k_all, v_all, mask, scale=scale), new_state

@@ -1,6 +1,6 @@
-"""Dense (contiguous, preallocated) KV cache.
+"""Dense (contiguous, preallocated) KV cache — bf16 and int8-quantized.
 
-The simplest of the three cache policies (dense / paged / sink). Unlike the
+The simplest of the cache policies (dense / paged / sink). Unlike the
 reference's ``torch.cat`` growth pattern
 (``/root/reference/distributed_llm_inference/models/llama/cache.py:108-109``),
 the buffer is preallocated at ``max_seq_len`` and written with per-row
@@ -13,10 +13,18 @@ Batch rows are independent sessions with their own write offsets
 ``generation_id``-keyed dict-of-tensors in the reference
 (``models/llama/cache.py:14-19``) becomes integer slot indexing into the batch
 dimension.
+
+:class:`QuantizedDenseKVCache` stores K/V as int8 with per-(token, head)
+fp32 scales — decode attention reads the whole active KV working set every
+step, so halving KV bytes directly buys decode bandwidth (KV traffic
+dominates weights at large batch). Dequantization is a broadcast multiply
+fused by XLA into the attention operand read; scales ride the layer-state
+tuple alongside the value planes (see ``cache/base.py``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Tuple
 
 import jax
@@ -28,7 +36,108 @@ from ..ops.rotary import RopeAngles, apply_rope
 from .base import GatherAttendMixin
 
 
-class DenseKVCache(GatherAttendMixin, struct.PyTreeNode):
+class _DenseRowsMixin(GatherAttendMixin):
+    """Shared row bookkeeping for contiguous per-row caches: absolute
+    positions from ``lengths``, bucket-safe writes, causal masking, and
+    generic (BATCH_AXES-driven) row slicing."""
+
+    def q_positions(self, seq_len: int) -> jnp.ndarray:
+        """Absolute positions of the incoming tokens: ``[B, S]``."""
+        return self.lengths[:, None] + jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def rope_positions(self, seq_len: int, num_new: jnp.ndarray) -> jnp.ndarray:
+        """Positions at which incoming queries are rotated (= absolute here;
+        the sink cache overrides this with window-relative positions)."""
+        return self.q_positions(seq_len)
+
+    def fits(self, num_new) -> jnp.ndarray:
+        """Per-row: can ``num_new`` more tokens be appended without overflow?
+
+        The scheduler MUST check this before admitting tokens: past capacity,
+        writes are dropped (see ``_write``) and the overflowing tokens
+        silently never enter the cache (engine contract).
+        """
+        return self.lengths + num_new <= self.max_len
+
+    def advance(self, num_new: jnp.ndarray):
+        return self.replace(lengths=self.lengths + num_new)
+
+    def reset_rows(self, row_mask: jnp.ndarray):
+        """Zero the lengths of rows where ``row_mask`` is True (slot reuse for
+        a new session — the analog of a fresh ``generation_id``, reference
+        ``models/llama/cache.py:78-84``). Stale k/v need no clearing: validity
+        derives from ``lengths``."""
+        return self.replace(lengths=jnp.where(row_mask, 0, self.lengths))
+
+    def _fields(self):
+        return [
+            f.name for f in dataclasses.fields(self)
+            if f.metadata.get("pytree_node", True)
+        ]
+
+    def select_row(self, row):
+        """Batch-1 view of one session row (jit-safe, ``row`` may be traced).
+        Used by the engine to prefill a newly admitted session without
+        touching (or recomputing over) the other rows."""
+        return self.replace(**{
+            name: jax.lax.dynamic_slice_in_dim(
+                getattr(self, name), row, 1, axis=self.BATCH_AXES[name]
+            )
+            for name in self._fields()
+        })
+
+    def merge_row(self, sub, row):
+        return self.replace(**{
+            name: jax.lax.dynamic_update_slice_in_dim(
+                getattr(self, name), getattr(sub, name), row,
+                axis=self.BATCH_AXES[name],
+            )
+            for name in self._fields()
+        })
+
+    def _write(self, layer_buf, new_vals, num_new):
+        """Merge incoming ``[B, S, ...]`` rows into ``[B, T, ...]`` at each
+        row's write offset (``lengths``)."""
+        b, s = new_vals.shape[:2]
+        t = layer_buf.shape[1]
+        if s == 1:
+            # Decode hot path: single-token contiguous write. Always in
+            # bounds — the scheduler's capacity check guarantees
+            # ``lengths + 1 <= max_len`` for active rows — and it partitions
+            # cleanly under SPMD (a scatter here trips XLA's partitioner).
+            def write_row(buf, val, start):
+                start_idx = (start,) + (0,) * (buf.ndim - 1)
+                return jax.lax.dynamic_update_slice(buf, val, start_idx)
+
+            return jax.vmap(write_row)(layer_buf, new_vals, self.lengths)
+        # Prefill: the chunk is padded to a bucket that may extend past
+        # the buffer end (bucket > remaining capacity), where a contiguous
+        # dynamic_update_slice would either fail to compile (update wider
+        # than operand) or clamp the start offset and silently overwrite
+        # earlier tokens. Rebuild the buffer as a gather + select instead
+        # (SPMD-friendly, unlike a scatter): buffer position p takes
+        # incoming row ``p - lengths`` when that lies in [0, num_new).
+        src = (
+            jnp.arange(t, dtype=jnp.int32)[None, :] - self.lengths[:, None]
+        )  # [B, T]: index into the incoming chunk
+        take = (src >= 0) & (src < num_new[:, None])
+        extra = new_vals.ndim - 2
+        idx = jnp.clip(src, 0, s - 1).reshape(b, t, *([1] * extra))
+        sel = take.reshape(b, t, *([1] * extra))
+        return jnp.where(
+            sel, jnp.take_along_axis(new_vals, idx, axis=1), layer_buf
+        )
+
+    def _mask(self, q, q_pos, num_new, sliding_window):
+        t = self.max_len
+        kv_pos = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None, :], (q.shape[0], t)
+        )
+        kv_valid = kv_pos < (self.lengths + num_new)[:, None]
+        return causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
+
+
+class DenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
     """``k``/``v``: ``[L, B, T, Hkv, D]`` (keys stored rotated); ``lengths``: ``[B]``."""
 
     k: jax.Array
@@ -61,61 +170,16 @@ class DenseKVCache(GatherAttendMixin, struct.PyTreeNode):
         return self.k.shape[2]
 
     @property
-    def layer_kv(self):
-        """Per-layer k/v stacks (leading dim = layers) for the model's scan."""
-        return self.k, self.v
+    def layer_stacks(self):
+        """Per-layer stacks (leading dim = layers) for the model's scan."""
+        return (self.k, self.v)
 
-    def with_layer_kv(self, new_k, new_v) -> "DenseKVCache":
+    def with_layer_stacks(self, new_k, new_v) -> "DenseKVCache":
         return self.replace(k=new_k, v=new_v)
-
-    def q_positions(self, seq_len: int) -> jnp.ndarray:
-        """Absolute positions of the incoming tokens: ``[B, S]``."""
-        return self.lengths[:, None] + jnp.arange(seq_len, dtype=jnp.int32)[None, :]
-
-    def rope_positions(self, seq_len: int, num_new: jnp.ndarray) -> jnp.ndarray:
-        """Positions at which incoming queries are rotated (= absolute here;
-        the sink cache overrides this with window-relative positions)."""
-        return self.q_positions(seq_len)
-
-    def reset_rows(self, row_mask: jnp.ndarray) -> "DenseKVCache":
-        """Zero the lengths of rows where ``row_mask`` is True (slot reuse for
-        a new session — the analog of a fresh ``generation_id``, reference
-        ``models/llama/cache.py:78-84``). Stale k/v need no clearing: validity
-        derives from ``lengths``."""
-        return self.replace(lengths=jnp.where(row_mask, 0, self.lengths))
-
-    def select_row(self, row) -> "DenseKVCache":
-        """Batch-1 view of one session row (jit-safe, ``row`` may be traced).
-        Used by the engine to prefill a newly admitted session without
-        touching (or recomputing over) the other rows."""
-        return self.replace(
-            k=jax.lax.dynamic_slice_in_dim(self.k, row, 1, axis=1),
-            v=jax.lax.dynamic_slice_in_dim(self.v, row, 1, axis=1),
-            lengths=jax.lax.dynamic_slice_in_dim(self.lengths, row, 1),
-        )
-
-    def merge_row(self, sub: "DenseKVCache", row) -> "DenseKVCache":
-        return self.replace(
-            k=jax.lax.dynamic_update_slice_in_dim(self.k, sub.k, row, axis=1),
-            v=jax.lax.dynamic_update_slice_in_dim(self.v, sub.v, row, axis=1),
-            lengths=jax.lax.dynamic_update_slice_in_dim(
-                self.lengths, sub.lengths, row, axis=0
-            ),
-        )
-
-    def fits(self, num_new) -> jnp.ndarray:
-        """Per-row: can ``num_new`` more tokens be appended without overflow?
-
-        The scheduler MUST check this before admitting tokens: past capacity,
-        writes are dropped (see ``update_and_gather``) and the overflowing
-        tokens silently never enter the cache (engine contract).
-        """
-        return self.lengths + num_new <= self.max_len
 
     def update_and_gather(
         self,
-        layer_k: jnp.ndarray,
-        layer_v: jnp.ndarray,
+        layer_state: Tuple[jnp.ndarray, ...],
         q: jnp.ndarray,
         k_new: jnp.ndarray,
         v_new: jnp.ndarray,
@@ -126,52 +190,108 @@ class DenseKVCache(GatherAttendMixin, struct.PyTreeNode):
     ) -> Tuple[jnp.ndarray, ...]:
         """Rotate q/k, write k/v into this layer's buffer, build the mask.
 
-        ``layer_k``/``layer_v``: ``[B, T, Hkv, D]`` (one layer's slice, as
-        delivered by ``lax.scan`` over the leading layer axis). ``rope`` holds
-        cos/sin precomputed once per block for ``q_pos``.
-        Returns ``(q_rot, k_all, v_all, mask, new_layer_k, new_layer_v)``.
+        ``layer_state``: ``(layer_k, layer_v)``, each ``[B, T, Hkv, D]`` (one
+        layer's slice, as delivered by ``lax.scan`` over the leading layer
+        axis). ``rope`` holds cos/sin precomputed once per block for
+        ``q_pos``. Returns ``(q_rot, k_all, v_all, mask, new_layer_state)``.
         """
+        layer_k, layer_v = layer_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        new_k = self._write(layer_k, k_rot, num_new)
+        new_v = self._write(layer_v, v_new, num_new)
+        mask = self._mask(q, q_pos, num_new, sliding_window)
+        return q_rot, new_k, new_v, mask, (new_k, new_v)
+
+
+def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(token, head) symmetric int8: ``x`` ``[B, S, H, D]`` →
+    ``(q int8 [B, S, H, D], scale f32 [B, S, H])``."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
+    """Dense cache with int8 K/V + per-(token, head) fp32 scales.
+
+    ``k``/``v``: int8 ``[L, B, T, Hkv, D]``; ``ks``/``vs``: f32
+    ``[L, B, T, Hkv]`` (≈3% byte overhead at D=128). The reference's cache is
+    unquantized fp16 torch tensors (``models/llama/cache.py``); int8 KV is
+    the TPU-native bandwidth play for the decode path, analogous to its
+    bitsandbytes int8 *weights* (``utils/model.py:93-123``) applied to the
+    cache instead.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    ks: jax.Array
+    vs: jax.Array
+    lengths: jax.Array
+
+    BATCH_AXES = {"k": 1, "v": 1, "ks": 1, "vs": 1, "lengths": 0}
+    LAYER_FIELDS = ("k", "v", "ks", "vs")
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        batch: int,
+        max_seq_len: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,  # accepted for interface parity; values are int8
+    ) -> "QuantizedDenseKVCache":
+        shape = (num_layers, batch, max_seq_len, num_kv_heads, head_dim)
+        sshape = shape[:-1]
+        return QuantizedDenseKVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            ks=jnp.zeros(sshape, jnp.float32),
+            vs=jnp.zeros(sshape, jnp.float32),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def layer_stacks(self):
+        return (self.k, self.v, self.ks, self.vs)
+
+    def with_layer_stacks(self, k, v, ks, vs) -> "QuantizedDenseKVCache":
+        return self.replace(k=k, v=v, ks=ks, vs=vs)
+
+    def update_and_gather(
+        self,
+        layer_state: Tuple[jnp.ndarray, ...],
+        q: jnp.ndarray,
+        k_new: jnp.ndarray,
+        v_new: jnp.ndarray,
+        rope: RopeAngles,
+        q_pos: jnp.ndarray,
+        num_new: jnp.ndarray,
+        sliding_window: Optional[int] = None,
+    ) -> Tuple[jnp.ndarray, ...]:
+        """As :meth:`DenseKVCache.update_and_gather`, but values are stored
+        int8 and returned DEQUANTIZED (a broadcast multiply XLA fuses into
+        the attention operand read — no materialized bf16 copy)."""
+        layer_k, layer_v, layer_ks, layer_vs = layer_state
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
 
-        b, s, hkv, d = k_new.shape
-        t = layer_k.shape[1]
-        if s == 1:
-            # Decode hot path: single-token contiguous write. Always in
-            # bounds — the scheduler's capacity check guarantees
-            # ``lengths + 1 <= max_len`` for active rows — and it partitions
-            # cleanly under SPMD (a scatter here trips XLA's partitioner).
-            def write_row(buf, val, start):
-                return jax.lax.dynamic_update_slice(buf, val, (start, 0, 0))
+        k_q, k_s = _quantize_kv(k_rot)
+        v_q, v_s = _quantize_kv(v_new)
+        new_k = self._write(layer_k, k_q, num_new)
+        new_v = self._write(layer_v, v_q, num_new)
+        new_ks = self._write(layer_ks, k_s, num_new)
+        new_vs = self._write(layer_vs, v_s, num_new)
 
-            new_k = jax.vmap(write_row)(layer_k, k_rot, self.lengths)
-            new_v = jax.vmap(write_row)(layer_v, v_new, self.lengths)
-        else:
-            # Prefill: the chunk is padded to a bucket that may extend past
-            # the buffer end (bucket > remaining capacity), where a contiguous
-            # dynamic_update_slice would either fail to compile (update wider
-            # than operand) or clamp the start offset and silently overwrite
-            # earlier tokens. Rebuild the buffer as a gather + select instead
-            # (SPMD-friendly, unlike a scatter): buffer position p takes
-            # incoming row ``p - lengths`` when that lies in [0, num_new).
-            src = (
-                jnp.arange(t, dtype=jnp.int32)[None, :] - self.lengths[:, None]
-            )  # [B, T]: index into the incoming chunk
-            take = (src >= 0) & (src < num_new[:, None])
-            idx = jnp.clip(src, 0, s - 1)[:, :, None, None]
-            sel = take[:, :, None, None]
-            new_k = jnp.where(
-                sel, jnp.take_along_axis(k_rot, idx, axis=1), layer_k
-            )
-            new_v = jnp.where(
-                sel, jnp.take_along_axis(v_new, idx, axis=1), layer_v
-            )
-        kv_pos = jnp.broadcast_to(
-            jnp.arange(t, dtype=jnp.int32)[None, :], (q.shape[0], t)
-        )
-        kv_valid = kv_pos < (self.lengths + num_new)[:, None]
-        mask = causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
-        return q_rot, new_k, new_v, mask, new_k, new_v
-
-    def advance(self, num_new: jnp.ndarray) -> "DenseKVCache":
-        return self.replace(lengths=self.lengths + num_new)
+        dt = q.dtype
+        k_all = new_k.astype(dt) * new_ks[..., None].astype(dt)
+        v_all = new_v.astype(dt) * new_vs[..., None].astype(dt)
+        mask = self._mask(q, q_pos, num_new, sliding_window)
+        return q_rot, k_all, v_all, mask, (new_k, new_v, new_ks, new_vs)

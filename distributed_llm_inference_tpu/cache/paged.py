@@ -80,10 +80,10 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         return self.page_table.shape[1] * self.page_size
 
     @property
-    def layer_kv(self):
-        return self.k_pages, self.v_pages
+    def layer_stacks(self):
+        return (self.k_pages, self.v_pages)
 
-    def with_layer_kv(self, new_k, new_v) -> "PagedKVCache":
+    def with_layer_stacks(self, new_k, new_v) -> "PagedKVCache":
         return self.replace(k_pages=new_k, v_pages=new_v)
 
     def q_positions(self, seq_len: int) -> jnp.ndarray:
@@ -166,8 +166,7 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
 
     def attend(
         self,
-        layer_k,
-        layer_v,
+        layer_state,
         q,
         k_new,
         v_new,
@@ -184,11 +183,12 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         gather+``attention_fn`` (``GatherAttendMixin``)."""
         if not self.use_kernel or q.shape[1] != 1:
             return super().attend(
-                layer_k, layer_v, q, k_new, v_new, rope, q_pos, num_new,
+                layer_state, q, k_new, v_new, rope, q_pos, num_new,
                 sliding_window, attention_fn, scale,
             )
         from ..ops.paged_attention import paged_attention
 
+        layer_k, layer_v = layer_state
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
         new_k, new_v = self._scatter(
@@ -198,12 +198,11 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             q_rot, new_k, new_v, self.page_table, self.lengths + num_new,
             scale=scale, sliding_window=sliding_window,
         )
-        return out, new_k, new_v
+        return out, (new_k, new_v)
 
     def update_and_gather(
         self,
-        layer_k: jnp.ndarray,
-        layer_v: jnp.ndarray,
+        layer_state: Tuple[jnp.ndarray, ...],
         q: jnp.ndarray,
         k_new: jnp.ndarray,
         v_new: jnp.ndarray,
@@ -214,11 +213,13 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     ) -> Tuple[jnp.ndarray, ...]:
         """Scatter new k/v into pages; gather each row's pages for attention.
 
-        ``layer_k``/``layer_v``: ``[P, Hkv, page_size, D]`` (one layer).
-        The gather materializes ``[B, max_pages_per_session * page_size, …]``
-        per layer — the XLA-fused correctness baseline. The Pallas paged
-        kernel (``ops/paged_attention.py``) reads pages in place instead.
+        ``layer_state``: ``(layer_k, layer_v)``, each ``[P, Hkv, page_size,
+        D]`` (one layer). The gather materializes
+        ``[B, max_pages_per_session * page_size, …]`` per layer — the
+        XLA-fused correctness baseline. The Pallas paged kernel
+        (``ops/paged_attention.py``) reads pages in place instead.
         """
+        layer_k, layer_v = layer_state
         b, s, hkv, d = k_new.shape
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
@@ -241,7 +242,7 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         )
         kv_valid = kv_pos < (self.lengths + num_new)[:, None]
         mask = causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
-        return q_rot, k_all, v_all, mask, new_k, new_v
+        return q_rot, k_all, v_all, mask, (new_k, new_v)
 
     def advance(self, num_new: jnp.ndarray) -> "PagedKVCache":
         return self.replace(lengths=self.lengths + num_new)

@@ -78,10 +78,10 @@ class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
         return self.k.shape[2]
 
     @property
-    def layer_kv(self):
-        return self.k, self.v
+    def layer_stacks(self):
+        return (self.k, self.v)
 
-    def with_layer_kv(self, new_k, new_v) -> "SinkKVCache":
+    def with_layer_stacks(self, new_k, new_v) -> "SinkKVCache":
         return self.replace(k=new_k, v=new_v)
 
     # -- position bookkeeping -------------------------------------------------
@@ -139,8 +139,7 @@ class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
 
     def update_and_gather(
         self,
-        layer_k: jnp.ndarray,
-        layer_v: jnp.ndarray,
+        layer_state: Tuple[jnp.ndarray, ...],
         q: jnp.ndarray,
         k_new: jnp.ndarray,
         v_new: jnp.ndarray,
@@ -152,9 +151,10 @@ class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
         """Write unrotated k/v into ring slots; rotate live keys to their
         effective positions; build the exact causal+liveness mask.
 
-        ``layer_k``/``layer_v``: ``[B, W, Hkv, D]``. ``sliding_window`` is
-        ignored — the ring *is* the window policy.
+        ``layer_state``: ``(layer_k, layer_v)``, each ``[B, W, Hkv, D]``.
+        ``sliding_window`` is ignored — the ring *is* the window policy.
         """
+        layer_k, layer_v = layer_state
         b, s_len = q.shape[:2]
         total = self.seen + num_new
 
@@ -179,7 +179,7 @@ class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
 
         # Causal on absolute positions; liveness excludes evicted/empty slots.
         mask = causal_mask(q_pos, kv_pos, kv_live)
-        return q_rot, k_eff, new_v, mask, new_k, new_v
+        return q_rot, k_eff, new_v, mask, (new_k, new_v)
 
     def advance(self, num_new: jnp.ndarray) -> "SinkKVCache":
         return self.replace(seen=self.seen + num_new)

@@ -145,6 +145,10 @@ class CacheConfig:
     """
 
     kind: str = "paged"  # "paged" | "sink" | "dense"
+    # KV value quantization: None (model dtype) | "int8" (per-token/head
+    # scales; dense kind only) — halves the decode path's dominant HBM
+    # traffic at large batch.
+    kv_quant: Optional[str] = None
     max_sessions: int = 32
     page_size: int = 64
     num_pages: int = 512

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..cache.dense import DenseKVCache
+from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
 from ..cache.paged import PageAllocator, PagedKVCache
 from ..cache.sink import SinkKVCache
 from ..config import CacheConfig, EngineConfig, ModelConfig
@@ -78,8 +78,18 @@ class InferenceEngine:
         self.batch = self.ecfg.max_batch_size
         dtype = jnp.dtype(self.ecfg.dtype)
         b, cc = self.batch, self.ccfg
+        if cc.kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown kv_quant {cc.kv_quant!r}")
+        if cc.kv_quant is not None and cc.kind != "dense":
+            raise ValueError(
+                f"kv_quant={cc.kv_quant!r} is only supported for the dense "
+                f"cache (got kind={cc.kind!r})"
+            )
         if cc.kind == "dense":
-            self.cache = DenseKVCache.create(
+            cache_cls = (
+                QuantizedDenseKVCache if cc.kv_quant == "int8" else DenseKVCache
+            )
+            self.cache = cache_cls.create(
                 cfg.num_layers, b, self.ecfg.max_seq_len, cfg.num_kv_heads,
                 cfg.head_dim, dtype,
             )
@@ -231,7 +241,7 @@ class InferenceEngine:
             return True
         limit = (
             self.ecfg.max_seq_len
-            if isinstance(self.cache, DenseKVCache)
+            if isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
             else self.ccfg.max_pages_per_session * self.ccfg.page_size
         )
         return len(s.prompt) + 1 <= limit
@@ -324,7 +334,7 @@ class InferenceEngine:
                         s.slot, new, start_slot=len(s.pages)
                     )
                     s.pages.extend(new)
-        elif isinstance(self.cache, DenseKVCache):
+        elif isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             for slot, gid in enumerate(self.slots):
                 if gid is None:
                     continue

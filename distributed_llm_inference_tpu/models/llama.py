@@ -112,14 +112,13 @@ def _decoder_layer(
     cfg: ModelConfig,
     p: Params,
     x: jnp.ndarray,
-    layer_k: jnp.ndarray,
-    layer_v: jnp.ndarray,
+    layer_state: Tuple[jnp.ndarray, ...],
     cache,
     rope: RopeAngles,
     q_pos: jnp.ndarray,
     num_new: jnp.ndarray,
     attention_fn=gqa_attention,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, ...]]:
     """One decoder layer: pre-norm attention + pre-norm SwiGLU MLP.
 
     Mirrors the reference layer structure (``modules.py:146-184``) minus its
@@ -141,8 +140,8 @@ def _decoder_layer(
     k = k.reshape(b, s, hkv, d)
     v = v.reshape(b, s, hkv, d)
 
-    attn, new_k, new_v = cache.attend(
-        layer_k, layer_v, q, k, v, rope, q_pos, num_new,
+    attn, new_state = cache.attend(
+        layer_state, q, k, v, rope, q_pos, num_new,
         cfg.sliding_window, attention_fn, d**-0.5,
     )
     o = qmatmul(attn.reshape(b, s, hq * d), p["wo"])
@@ -155,7 +154,7 @@ def _decoder_layer(
         mlp = moe_mlp(cfg, p, h2)
     else:
         mlp = qmatmul(jax.nn.silu(qmatmul(h2, p["wg"])) * qmatmul(h2, p["wu"]), p["wd"])
-    return x + mlp, new_k, new_v
+    return x + mlp, new_state
 
 
 def block_apply(
@@ -184,31 +183,34 @@ def block_apply(
     cos, sin = rope_cos_sin(rot_pos, inv_freq)
     rope = RopeAngles(inv_freq, cos, sin)
 
-    lk, lv = cache.layer_kv
-    num_stack = lk.shape[0]
+    stacks = cache.layer_stacks  # tuple of [L, ...] arrays (k/v [+ scales])
+    num_stack = stacks[0].shape[0]
 
-    # KV buffers ride the scan CARRY and are updated in place at the layer
+    # Cache buffers ride the scan CARRY and are updated in place at the layer
     # index — carries are aliased by XLA, so a decode step writes one token
-    # per layer. Returning per-layer KV as stacked scan outputs instead would
-    # materialize a full copy of the whole cache every step, doubling HBM
-    # traffic on the bandwidth-bound decode path.
+    # per layer. Returning per-layer state as stacked scan outputs instead
+    # would materialize a full copy of the whole cache every step, doubling
+    # HBM traffic on the bandwidth-bound decode path.
     def step(carry, xs):
-        x, ks, vs = carry
+        x, bufs = carry
         p, idx = xs
-        layer_k = jax.lax.dynamic_index_in_dim(ks, idx, 0, keepdims=False)
-        layer_v = jax.lax.dynamic_index_in_dim(vs, idx, 0, keepdims=False)
-        out, new_k, new_v = _decoder_layer(
-            cfg, p, x, layer_k, layer_v, cache, rope, q_pos, num_new,
-            attention_fn,
+        layer_state = tuple(
+            jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
+            for b in bufs
         )
-        ks = jax.lax.dynamic_update_index_in_dim(ks, new_k, idx, 0)
-        vs = jax.lax.dynamic_update_index_in_dim(vs, new_v, idx, 0)
-        return (out, ks, vs), None
+        out, new_state = _decoder_layer(
+            cfg, p, x, layer_state, cache, rope, q_pos, num_new, attention_fn
+        )
+        bufs = tuple(
+            jax.lax.dynamic_update_index_in_dim(b, n, idx, 0)
+            for b, n in zip(bufs, new_state)
+        )
+        return (out, bufs), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
-        step, (x, lk, lv), (layer_params, jnp.arange(num_stack))
+    (x, new_stacks), _ = jax.lax.scan(
+        step, (x, stacks), (layer_params, jnp.arange(num_stack))
     )
-    return x, cache.with_layer_kv(new_k, new_v)
+    return x, cache.with_layer_stacks(*new_stacks)
 
 
 def model_apply(

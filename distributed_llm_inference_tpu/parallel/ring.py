@@ -16,7 +16,7 @@ sequence axis is sharded over ``sp`` and attention runs as a ring —
 Like the pipeline, ``shard_map`` is manual over ``sp`` only, so ``tp``/``dp``
 shardings stay automatic and the same model code composes. The layer stack is
 reused verbatim through :class:`RingChunkCache` — an adapter that satisfies the
-cache protocol (``q_positions``/``update_and_gather``/``layer_kv``) for a
+cache protocol (``q_positions``/``update_and_gather``/``layer_stacks``) for a
 fresh-chunk prefill, with the ring kernel injected as ``attention_fn``.
 """
 
@@ -125,10 +125,10 @@ class RingChunkCache(GatherAttendMixin, struct.PyTreeNode):
     LAYER_FIELDS = ("k", "v")
 
     @property
-    def layer_kv(self):
-        return self.k, self.v
+    def layer_stacks(self):
+        return (self.k, self.v)
 
-    def with_layer_kv(self, new_k, new_v) -> "RingChunkCache":
+    def with_layer_stacks(self, new_k, new_v) -> "RingChunkCache":
         return self.replace(k=new_k, v=new_v)
 
     def q_positions(self, seq_len: int) -> jnp.ndarray:
@@ -139,13 +139,13 @@ class RingChunkCache(GatherAttendMixin, struct.PyTreeNode):
         return self.q_positions(seq_len)
 
     def update_and_gather(
-        self, layer_k, layer_v, q, k_new, v_new, rope, q_pos, num_new,
+        self, layer_state, q, k_new, v_new, rope, q_pos, num_new,
         sliding_window=None,
     ):
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
         # mask=None: the ring attention_fn builds per-visit masks itself.
-        return q_rot, k_rot, v_new, None, k_rot, v_new
+        return q_rot, k_rot, v_new, None, (k_rot, v_new)
 
     def advance(self, num_new: jnp.ndarray) -> "RingChunkCache":
         return self

@@ -123,11 +123,15 @@ def cache_pspecs(cache: Any, use_pp: bool = False) -> Any:
     KV heads shard over ``tp`` (reads/writes stay device-local); batch rows
     over ``dp``; the layer axis over ``pp`` when pipelining.
     """
-    from ..cache.dense import DenseKVCache
+    from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
     from ..cache.paged import PagedKVCache
     from ..cache.sink import SinkKVCache
 
     pp = "pp" if use_pp else None
+    if isinstance(cache, QuantizedDenseKVCache):
+        kv = P(pp, "dp", None, "tp", None)
+        sc = P(pp, "dp", None, "tp")
+        return QuantizedDenseKVCache(k=kv, v=kv, ks=sc, vs=sc, lengths=P("dp"))
     if isinstance(cache, DenseKVCache):
         kv = P(pp, "dp", None, "tp", None)
         return DenseKVCache(k=kv, v=kv, lengths=P("dp"))

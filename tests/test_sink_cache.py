@@ -67,9 +67,9 @@ def test_sink_attention_matches_oracle_through_wraparound():
         rot_pos = cache.rope_positions(1, num_new)
         cos, sin = rope_cos_sin(rot_pos, inv_freq)
         rope = RopeAngles(inv_freq, cos, sin)
-        q_rot, k_eff, v_all, mask, new_k, new_v = cache.update_and_gather(
-            cache.k[0], cache.v[0], q[None, None], k[None, None], v[None, None],
-            rope, q_pos, num_new,
+        q_rot, k_eff, v_all, mask, (new_k, new_v) = cache.update_and_gather(
+            (cache.k[0], cache.v[0]), q[None, None], k[None, None],
+            v[None, None], rope, q_pos, num_new,
         )
         out = gqa_attention(q_rot, k_eff, v_all, mask)[0, 0]
         cache = cache.replace(k=new_k[None], v=new_v[None]).advance(num_new)
