@@ -80,7 +80,7 @@ def cmd_serve(args) -> int:
     cfg = checkpoint.load_config(args.model)
     params = checkpoint.load_block_params(
         args.model, cfg, list(range(first, last + 1)),
-        jnp.dtype(args.dtype),
+        jnp.dtype(args.dtype), cache_dir=args.weights_cache,
     )
     node = ServingNode(
         port, cfg, params["layers"], first, last, host=host,
@@ -141,7 +141,9 @@ def cmd_local(args) -> int:
     from .utils import checkpoint
 
     cfg = checkpoint.load_config(args.model)
-    params = checkpoint.load_model_params(args.model, cfg, jnp.dtype(args.dtype))
+    params = checkpoint.load_model_params(
+        args.model, cfg, jnp.dtype(args.dtype), cache_dir=args.weights_cache
+    )
     engine = InferenceEngine(
         cfg, params,
         EngineConfig(
@@ -213,6 +215,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-sessions", type=int, default=8)
     s.add_argument("--max-seq-len", type=int, default=512)
     s.add_argument("--dtype", default="bfloat16")
+    s.add_argument("--weights-cache", default=None,
+                   help="directory for pre-converted weight caching "
+                        "(skips HF-layout conversion on repeat bring-up)")
     s.set_defaults(fn=cmd_serve)
 
     g = sub.add_parser("generate", help="generate through registered nodes")
@@ -239,6 +244,8 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--max-sessions", type=int, default=8)
     l.add_argument("--max-seq-len", type=int, default=2048)
     l.add_argument("--dtype", default="bfloat16")
+    l.add_argument("--weights-cache", default=None,
+                   help="directory for pre-converted weight caching")
     l.add_argument("--profile-dir", default=None,
                    help="dump a jax.profiler device trace + host span "
                         "timeline (Perfetto-loadable) into this directory")

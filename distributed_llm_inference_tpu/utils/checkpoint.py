@@ -80,6 +80,17 @@ def find_index(resolve: Callable[[str], Optional[str]]) -> str:
 
 
 def _read_tensors_safetensors(path: str, wanted: Callable[[str], bool]):
+    # Native C++ reader first (mmap + multithreaded copies,
+    # ``native/streader.cc`` — the data-loader tier the reference delegates
+    # to the Rust safetensors extension); pure-Python wheel as fallback.
+    from . import streader
+
+    if streader.native_available():
+        try:
+            with streader.NativeSafetensors(path) as f:
+                return f.read_many([k for k in f.keys() if wanted(k)])
+        except Exception:
+            pass  # unreadable via native path: fall through to the wheel
     from safetensors import safe_open
 
     out: Dict[str, np.ndarray] = {}
@@ -159,12 +170,24 @@ def load_block_params(
     layer_ids: Sequence[int],
     dtype=jnp.bfloat16,
     resolve: Optional[Callable[[str], Optional[str]]] = None,
+    cache_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Stacked layer params for the block a node serves — the analog of
     ``load_block`` (``utils/model.py:75-90``), returning ``{"layers": …}``
-    ready for :func:`models.llama.block_apply`."""
-    state = block_state_dict(model_dir, layer_ids, resolve=resolve)
-    return llama.convert_hf_state_dict(cfg, state, layer_ids, dtype)
+    ready for :func:`models.llama.block_apply`.
+
+    ``cache_dir`` enables the pre-converted on-disk cache (SURVEY §5.4): the
+    first load writes the already-stacked/transposed arrays there; repeat
+    bring-up of the same block then skips the HF-layout conversion and the
+    unrelated-layer shard reads entirely.
+    """
+    def build():
+        state = block_state_dict(model_dir, layer_ids, resolve=resolve)
+        return llama.convert_hf_state_dict(cfg, state, layer_ids, dtype)
+
+    return _cached_load(
+        build, model_dir, cache_dir, layer_ids, dtype, resolve, tag="block"
+    )
 
 
 def load_model_params(
@@ -172,13 +195,111 @@ def load_model_params(
     cfg: ModelConfig,
     dtype=jnp.bfloat16,
     resolve: Optional[Callable[[str], Optional[str]]] = None,
+    cache_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Full-model params (embedding + all layers + head) for single-node /
-    client use."""
-    state = block_state_dict(
-        model_dir, None, include_non_layer=True, resolve=resolve
+    client use. ``cache_dir``: see :func:`load_block_params`."""
+    def build():
+        state = block_state_dict(
+            model_dir, None, include_non_layer=True, resolve=resolve
+        )
+        return llama.convert_hf_state_dict(cfg, state, None, dtype)
+
+    return _cached_load(
+        build, model_dir, cache_dir, None, dtype, resolve, tag="model"
     )
-    return llama.convert_hf_state_dict(cfg, state, None, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pre-converted on-disk cache (SURVEY §5.4: "optional on-disk cache of
+# pre-sharded arrays" — the reference re-parses HF shards on every bring-up)
+# ---------------------------------------------------------------------------
+
+
+def _flatten_params(params: Mapping[str, Any], prefix="") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, Mapping):
+            out.update(_flatten_params(v, prefix=f"{key}."))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten_params(flat: Mapping[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        node = out
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def _cache_key(
+    entry_path: str,
+    layer_ids: Optional[Sequence[int]],
+    dtype,
+    tag: str,
+    resolve: Callable[[str], Optional[str]],
+) -> str:
+    """Content key: identity (path + size + mtime) of the entry file, every
+    shard it maps to, and config.json, × layer span × dtype × layout
+    version — so replacing any shard (or the model config) invalidates the
+    cache even when the index file itself is byte-identical."""
+    def ident(path: Optional[str]):
+        if path is None or not os.path.exists(path):
+            return None
+        st = os.stat(path)
+        return [os.path.abspath(path), st.st_size, int(st.st_mtime_ns)]
+
+    files = [ident(entry_path)]
+    if entry_path.endswith(".index.json"):
+        with open(entry_path) as f:
+            shards = sorted(set(json.load(f).get("weight_map", {}).values()))
+        files += [ident(resolve(s)) for s in shards]
+    files.append(ident(resolve("config.json")))
+    blob = json.dumps([
+        "v1", tag, files,
+        list(layer_ids) if layer_ids is not None else None,
+        str(jnp.dtype(dtype)),
+    ])
+    import hashlib
+
+    return hashlib.sha1(blob.encode()).hexdigest()[:20]
+
+
+def _cached_load(build, model_dir, cache_dir, layer_ids, dtype, resolve, tag):
+    if cache_dir is None:
+        return build()
+    # NOTE: numpy framework (via save_safetensors' forced host-contiguous
+    # copies), NOT safetensors.flax — flax's writer serializes TPU-resident
+    # buffers with their padded tile layout, silently corrupting
+    # non-tile-aligned shapes (observed on v5e). bf16 round-trips as
+    # ml_dtypes.bfloat16.
+    from safetensors.numpy import load_file
+
+    resolve = resolve or _default_resolve(model_dir)
+    entry = find_index(resolve)
+    key = _cache_key(entry, layer_ids, dtype, tag, resolve)
+    path = os.path.join(cache_dir, f"{tag}-{key}.safetensors")
+    if os.path.exists(path):
+        try:
+            flat = load_file(path)
+        except Exception:
+            pass  # corrupt/partial cache entry: rebuild below
+        else:
+            return _unflatten_params(
+                {k: jnp.asarray(v) for k, v in flat.items()}
+            )
+    params = build()
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    save_safetensors(_flatten_params(params), tmp)
+    os.replace(tmp, path)  # atomic: concurrent loaders see whole files only
+    return params
 
 
 def load_client_params(

@@ -180,3 +180,115 @@ def test_load_config(tmp_path):
 def test_missing_index_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         checkpoint.block_state_dict(str(tmp_path), [0])
+
+
+# ---------------------------------------------------------------------------
+# Pre-converted on-disk weight cache (SURVEY §5.4)
+# ---------------------------------------------------------------------------
+
+
+def test_weights_cache_roundtrip_and_hit(tmp_path, monkeypatch):
+    state = _hf_state(CFG)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    _write_sharded(model_dir, state)
+    cache_dir = str(tmp_path / "wcache")
+
+    ref = checkpoint.load_model_params(model_dir, CFG, jnp.float32)
+    out = checkpoint.load_model_params(
+        model_dir, CFG, jnp.float32, cache_dir=cache_dir
+    )
+    entries = [f for f in os.listdir(cache_dir) if f.endswith(".safetensors")]
+    assert len(entries) == 1
+
+    # Second load must come from the cache: poison the slow path.
+    def boom(*a, **k):
+        raise AssertionError("cache miss: block_state_dict called")
+
+    monkeypatch.setattr(checkpoint, "block_state_dict", boom)
+    cached = checkpoint.load_model_params(
+        model_dir, CFG, jnp.float32, cache_dir=cache_dir
+    )
+    for tree in (out, cached):
+        assert set(tree) == set(ref) and set(tree["layers"]) == set(ref["layers"])
+        for name in ref["layers"]:
+            np.testing.assert_array_equal(
+                np.asarray(tree["layers"][name]), np.asarray(ref["layers"][name])
+            )
+        np.testing.assert_array_equal(np.asarray(tree["embed"]), np.asarray(ref["embed"]))
+
+
+def test_weights_cache_block_key_varies_by_span_and_dtype(tmp_path):
+    state = _hf_state(CFG)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    _write_sharded(model_dir, state)
+    cache_dir = str(tmp_path / "wcache")
+
+    checkpoint.load_block_params(model_dir, CFG, [0, 1], jnp.float32, cache_dir=cache_dir)
+    checkpoint.load_block_params(model_dir, CFG, [2, 3], jnp.float32, cache_dir=cache_dir)
+    checkpoint.load_block_params(model_dir, CFG, [0, 1], jnp.bfloat16, cache_dir=cache_dir)
+    entries = [f for f in os.listdir(cache_dir) if f.endswith(".safetensors")]
+    assert len(entries) == 3  # distinct keys, no collisions
+
+
+def test_weights_cache_invalidated_by_checkpoint_change(tmp_path):
+    state = _hf_state(CFG)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    _write_sharded(model_dir, state)
+    cache_dir = str(tmp_path / "wcache")
+
+    a = checkpoint.load_block_params(model_dir, CFG, [0], jnp.float32, cache_dir=cache_dir)
+    # "Re-download" the checkpoint with different weights.
+    state2 = _hf_state(CFG, seed=9)
+    _write_sharded(model_dir, state2)
+    os.utime(checkpoint.find_index(checkpoint._default_resolve(model_dir)))
+    b = checkpoint.load_block_params(model_dir, CFG, [0], jnp.float32, cache_dir=cache_dir)
+    assert not np.array_equal(
+        np.asarray(a["layers"]["wq"]), np.asarray(b["layers"]["wq"])
+    )
+
+
+def test_weights_cache_corrupt_entry_rebuilds(tmp_path):
+    state = _hf_state(CFG)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    _write_sharded(model_dir, state)
+    cache_dir = tmp_path / "wcache"
+
+    ref = checkpoint.load_block_params(model_dir, CFG, [0], jnp.float32,
+                                       cache_dir=str(cache_dir))
+    entry = next(cache_dir.glob("*.safetensors"))
+    entry.write_bytes(b"garbage")
+    again = checkpoint.load_block_params(model_dir, CFG, [0], jnp.float32,
+                                         cache_dir=str(cache_dir))
+    np.testing.assert_array_equal(
+        np.asarray(ref["layers"]["wq"]), np.asarray(again["layers"]["wq"])
+    )
+
+
+def test_weights_cache_invalidated_by_shard_change_only(tmp_path):
+    """Replacing a shard while the index file stays byte-identical must still
+    invalidate the cache (the key covers shard identities too)."""
+    state = _hf_state(CFG)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    _write_sharded(model_dir, state)
+    cache_dir = str(tmp_path / "wcache")
+
+    a = checkpoint.load_block_params(model_dir, CFG, [0], jnp.float32,
+                                     cache_dir=cache_dir)
+    # Rewrite ONE shard with different weights; index json untouched.
+    state2 = _hf_state(CFG, seed=9)
+    shard1 = {k: v for k, v in state2.items()
+              if not any(k.startswith(f"model.layers.{i}.") for i in (2, 3))
+              and k not in ("model.norm.weight", "lm_head.weight")}
+    checkpoint.save_safetensors(
+        shard1, os.path.join(model_dir, "model-00001-of-00002.safetensors")
+    )
+    b = checkpoint.load_block_params(model_dir, CFG, [0], jnp.float32,
+                                     cache_dir=cache_dir)
+    assert not np.array_equal(
+        np.asarray(a["layers"]["wq"]), np.asarray(b["layers"]["wq"])
+    )
