@@ -50,6 +50,27 @@ def _parse_ids(spec: str) -> List[int]:
     return [int(t) for t in spec.replace(" ", "").split(",") if t]
 
 
+def _resolve_prompt(args) -> Tuple[List[int], Optional[object]]:
+    """``(prompt_ids, tokenizer)`` from ``--prompt-ids`` or ``--prompt``
+    (the latter tokenizes with the checkpoint's tokenizer via transformers
+    and enables text detokenization of the output). Call BEFORE loading
+    weights so argument errors are instant. The parser enforces exactly one
+    of the two flags."""
+    if getattr(args, "prompt", None) is not None:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(args.model)
+        except Exception as e:
+            raise SystemExit(
+                f"--prompt needs a loadable tokenizer in {args.model!r}: {e}"
+            )
+        return tok(args.prompt)["input_ids"], tok
+    if getattr(args, "prompt_ids", None) is None:
+        raise SystemExit("one of --prompt / --prompt-ids is required")
+    return _parse_ids(args.prompt_ids), None
+
+
 def cmd_relay(args) -> int:
     from .distributed.directory import DirectoryService
     from .distributed.relay import RelayServer
@@ -109,9 +130,9 @@ def cmd_generate(args) -> int:
     from .utils import checkpoint
 
     host, port = _parse_relay(args.relay)
+    prompt, tok = _resolve_prompt(args)
     cfg = checkpoint.load_config(args.model)
     params = checkpoint.load_client_params(args.model, cfg, jnp.dtype(args.dtype))
-    prompt = _parse_ids(args.prompt_ids)
     with DistributedClient(
         port, cfg, params, host=host, dtype=jnp.dtype(args.dtype)
     ) as client:
@@ -127,8 +148,10 @@ def cmd_generate(args) -> int:
         out = client.generate(
             prompt, max_new_tokens=args.max_new, eos_token_id=args.eos
         )
-    print(json.dumps({"event": "generated", "prompt": prompt, "tokens": out}),
-          flush=True)
+    doc = {"event": "generated", "prompt": prompt, "tokens": out}
+    if tok is not None:
+        doc["text"] = tok.decode(out)
+    print(json.dumps(doc), flush=True)
     return 0
 
 
@@ -140,6 +163,7 @@ def cmd_local(args) -> int:
     from .engine.sampling import SamplingOptions
     from .utils import checkpoint
 
+    prompt, tok = _resolve_prompt(args)
     cfg = checkpoint.load_config(args.model)
     params = checkpoint.load_model_params(
         args.model, cfg, jnp.dtype(args.dtype), cache_dir=args.weights_cache
@@ -153,7 +177,6 @@ def cmd_local(args) -> int:
         ),
         CacheConfig(kind=args.cache),
     )
-    prompt = _parse_ids(args.prompt_ids)
     t0 = time.monotonic()
     from .utils.tracing import profile_trace
 
@@ -171,18 +194,27 @@ def cmd_local(args) -> int:
         engine.spans.dump_chrome_trace(
             os.path.join(args.profile_dir, "host_spans.json")
         )
-    print(json.dumps({
+    doc = {
         "event": "generated", "prompt": prompt, "tokens": outs[0],
         "seconds": round(dt, 3),
         "metrics": engine.metrics.snapshot(),
-    }), flush=True)
+    }
+    if tok is not None:
+        doc["text"] = tok.decode(outs[0])
+    print(json.dumps(doc), flush=True)
     return 0
 
 
 def cmd_info(args) -> int:
+    from .models import registry
     from .utils import checkpoint
 
-    cfg = checkpoint.load_config(args.model)
+    cfg = checkpoint.load_config(args.model, validate=False)
+    try:
+        registry.validate_config(cfg)
+        supported = True
+    except (KeyError, ValueError):
+        supported = False
     resolve = checkpoint._default_resolve(args.model)
     entry = checkpoint.find_index(resolve)
     print(json.dumps({
@@ -190,7 +222,7 @@ def cmd_info(args) -> int:
         "num_layers": cfg.num_layers, "hidden_size": cfg.hidden_size,
         "num_heads": cfg.num_heads, "num_kv_heads": cfg.num_kv_heads,
         "vocab_size": cfg.vocab_size, "num_experts": cfg.num_experts,
-        "sliding_window": cfg.sliding_window,
+        "sliding_window": cfg.sliding_window, "supported": supported,
     }, indent=2))
     return 0
 
@@ -223,7 +255,10 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("generate", help="generate through registered nodes")
     g.add_argument("--model", required=True)
     g.add_argument("--relay", required=True)
-    g.add_argument("--prompt-ids", required=True, help="comma-separated ids")
+    gp = g.add_mutually_exclusive_group(required=True)
+    gp.add_argument("--prompt-ids", default=None, help="comma-separated ids")
+    gp.add_argument("--prompt", default=None,
+                    help="text prompt (tokenized with the model's tokenizer)")
     g.add_argument("--max-new", type=int, default=16)
     g.add_argument("--eos", type=int, default=None)
     g.add_argument("--dtype", default="bfloat16")
@@ -233,7 +268,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     l = sub.add_parser("local", help="single-host engine generate")
     l.add_argument("--model", required=True)
-    l.add_argument("--prompt-ids", required=True)
+    lp = l.add_mutually_exclusive_group(required=True)
+    lp.add_argument("--prompt-ids", default=None)
+    lp.add_argument("--prompt", default=None,
+                    help="text prompt (tokenized with the model's tokenizer)")
     l.add_argument("--max-new", type=int, default=16)
     l.add_argument("--eos", type=int, default=None)
     l.add_argument("--temperature", type=float, default=0.0)

@@ -333,11 +333,21 @@ def save_safetensors(state: Mapping[str, Any], path: str) -> None:
     )
 
 
-def load_config(model_dir: str) -> ModelConfig:
+def load_config(model_dir: str, validate: bool = True) -> ModelConfig:
     """``config.json`` → :class:`ModelConfig` (the ``AutoConfig`` role,
-    ``utils/model.py:83``, without requiring transformers)."""
+    ``utils/model.py:83``, without requiring transformers).
+
+    ``validate`` checks the model family against the registry — an
+    unsupported ``model_type`` fails HERE rather than silently running the
+    llama program over a foreign architecture's weights.
+    """
     with open(os.path.join(model_dir, "config.json")) as f:
-        return ModelConfig.from_hf_config(json.load(f))
+        cfg = ModelConfig.from_hf_config(json.load(f))
+    if validate:
+        from ..models import registry
+
+        registry.validate_config(cfg)
+    return cfg
 
 
 def shard_put(params: Dict[str, Any], mesh, use_pp: bool = False):

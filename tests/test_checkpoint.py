@@ -292,3 +292,13 @@ def test_weights_cache_invalidated_by_shard_change_only(tmp_path):
     assert not np.array_equal(
         np.asarray(a["layers"]["wq"]), np.asarray(b["layers"]["wq"])
     )
+
+
+def test_load_config_rejects_unsupported_family(tmp_path):
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump({"model_type": "gpt2", "vocab_size": 64, "hidden_size": 16,
+                   "num_hidden_layers": 2, "num_attention_heads": 2}, f)
+    with pytest.raises(KeyError):
+        checkpoint.load_config(str(tmp_path))
+    cfg = checkpoint.load_config(str(tmp_path), validate=False)
+    assert cfg.family == "gpt2"
