@@ -164,43 +164,72 @@ def cmd_local(args) -> int:
     from .utils import checkpoint
 
     prompt, tok = _resolve_prompt(args)
+    if args.speculative_draft:
+        # Greedy-only path with its own dense caches: reject flags it would
+        # otherwise silently ignore.
+        if args.temperature:
+            raise SystemExit("--speculative-draft is greedy-only "
+                             "(remove --temperature)")
+        if args.quantize or args.int8:
+            raise SystemExit("--speculative-draft does not support weight "
+                             "quantization yet")
     cfg = checkpoint.load_config(args.model)
     params = checkpoint.load_model_params(
         args.model, cfg, jnp.dtype(args.dtype), cache_dir=args.weights_cache
     )
-    engine = InferenceEngine(
-        cfg, params,
-        EngineConfig(
-            max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
-            max_new_tokens=args.max_new, dtype=args.dtype,
-            quantization=args.quantize or ("int8" if args.int8 else None),
-        ),
-        CacheConfig(kind=args.cache),
-    )
-    t0 = time.monotonic()
     from .utils.tracing import profile_trace
 
-    with profile_trace(args.profile_dir):
-        outs = engine.generate(
-            [prompt],
-            SamplingOptions(temperature=args.temperature,
-                            max_new_tokens=args.max_new,
-                            eos_token_id=args.eos if args.eos is not None else -1),
-        )
-    dt = time.monotonic() - t0
-    if args.profile_dir:
-        import os
+    extra = {}
+    t0 = time.monotonic()
+    if args.speculative_draft:
+        from .engine.speculative import SpeculativeDecoder
 
-        engine.spans.dump_chrome_trace(
-            os.path.join(args.profile_dir, "host_spans.json")
+        dcfg = checkpoint.load_config(args.speculative_draft)
+        dparams = checkpoint.load_model_params(
+            args.speculative_draft, dcfg, jnp.dtype(args.dtype),
+            cache_dir=args.weights_cache,
         )
+        dec = SpeculativeDecoder(
+            cfg, params, dcfg, dparams, k=args.speculative_k,
+            max_seq_len=args.max_seq_len, dtype=jnp.dtype(args.dtype),
+        )
+        with profile_trace(args.profile_dir):
+            out = dec.generate(prompt, max_new_tokens=args.max_new,
+                               eos_token_id=args.eos)
+        extra["speculative"] = {
+            **dec.stats, "acceptance_rate": round(dec.acceptance_rate, 4),
+        }
+    else:
+        engine = InferenceEngine(
+            cfg, params,
+            EngineConfig(
+                max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
+                max_new_tokens=args.max_new, dtype=args.dtype,
+                quantization=args.quantize or ("int8" if args.int8 else None),
+            ),
+            CacheConfig(kind=args.cache),
+        )
+        with profile_trace(args.profile_dir):
+            out = engine.generate(
+                [prompt],
+                SamplingOptions(
+                    temperature=args.temperature, max_new_tokens=args.max_new,
+                    eos_token_id=args.eos if args.eos is not None else -1,
+                ),
+            )[0]
+        if args.profile_dir:
+            import os
+
+            engine.spans.dump_chrome_trace(
+                os.path.join(args.profile_dir, "host_spans.json")
+            )
+        extra["metrics"] = engine.metrics.snapshot()
     doc = {
-        "event": "generated", "prompt": prompt, "tokens": outs[0],
-        "seconds": round(dt, 3),
-        "metrics": engine.metrics.snapshot(),
+        "event": "generated", "prompt": prompt, "tokens": out,
+        "seconds": round(time.monotonic() - t0, 3), **extra,
     }
     if tok is not None:
-        doc["text"] = tok.decode(outs[0])
+        doc["text"] = tok.decode(out)
     print(json.dumps(doc), flush=True)
     return 0
 
@@ -284,6 +313,10 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--dtype", default="bfloat16")
     l.add_argument("--weights-cache", default=None,
                    help="directory for pre-converted weight caching")
+    l.add_argument("--speculative-draft", default=None,
+                   help="draft model checkpoint dir: greedy speculative "
+                        "decoding (same tokenizer/vocab as --model)")
+    l.add_argument("--speculative-k", type=int, default=4)
     l.add_argument("--profile-dir", default=None,
                    help="dump a jax.profiler device trace + host span "
                         "timeline (Perfetto-loadable) into this directory")

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import collections
 import math
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -74,6 +75,12 @@ class InferenceEngine:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics = Metrics()
         self.spans = SpanRecorder()
+        # Scheduler lock (SURVEY §5.2): submit()/cancel() may be called from
+        # request-handler threads while a server loop runs step(); all
+        # scheduler state (slots, waiting, sessions, cache, allocator) is
+        # mutated only under this lock. Public methods never call each other
+        # while holding it.
+        self._lock = threading.Lock()
 
         self.batch = self.ecfg.max_batch_size
         dtype = jnp.dtype(self.ecfg.dtype)
@@ -155,23 +162,26 @@ class InferenceEngine:
     # -- public API -----------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], options: Optional[SamplingOptions] = None) -> str:
-        """Queue a prompt; returns its generation_id."""
+        """Queue a prompt; returns its generation_id. Thread-safe."""
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         s = Session(prompt=list(prompt), options=options or SamplingOptions())
-        self.sessions[s.generation_id] = s
-        self.waiting.append(s)
+        with self._lock:
+            self.sessions[s.generation_id] = s
+            self.waiting.append(s)
         self.metrics.counter("sessions_submitted")
         return s.generation_id
 
     def cancel(self, generation_id: str) -> None:
-        s = self.sessions.get(generation_id)
-        if s is None or s.state == SessionState.FINISHED:
-            return
-        s.state = SessionState.CANCELLED
-        s.finish_reason = "cancelled"
-        if s.slot is not None:
-            self._release(s)
+        """Thread-safe."""
+        with self._lock:
+            s = self.sessions.get(generation_id)
+            if s is None or s.state == SessionState.FINISHED:
+                return
+            s.state = SessionState.CANCELLED
+            s.finish_reason = "cancelled"
+            if s.slot is not None:
+                self._release(s)
 
     def step(self) -> List[Tuple[str, int, bool]]:
         """One scheduler tick: admit + decode. Returns
@@ -179,13 +189,15 @@ class InferenceEngine:
         signals a finish without a new token (capacity rejection/exhaustion) —
         streaming consumers must not append it."""
         produced: List[Tuple[str, int, bool]] = []
-        self._admit(produced)
-        if any(slot is not None for slot in self.slots):
-            self._decode_tick(produced)
+        with self._lock:
+            self._admit(produced)
+            if any(slot is not None for slot in self.slots):
+                self._decode_tick(produced)
         return produced
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(s is not None for s in self.slots)
+        with self._lock:
+            return bool(self.waiting) or any(s is not None for s in self.slots)
 
     def generate(
         self,
@@ -195,25 +207,28 @@ class InferenceEngine:
     ) -> List[List[int]]:
         """Blocking convenience API: run all prompts to completion."""
         ids = [self.submit(p, options) for p in prompts]
-        for _ in range(max_steps):
+        with self._lock:  # hold Session objects: a concurrent
+            subs = [self.sessions[i] for i in ids]  # collect_finished() may
+        for _ in range(max_steps):                  # reap the dict entries
             if not self.has_work():
                 break
             self.step()
-        return [self.sessions[i].generated for i in ids]
+        return [s.generated for s in subs]
 
     def collect_finished(self) -> Dict[str, Session]:
         """Remove and return finished/cancelled sessions. Callers that stream
         via ``step()`` must collect periodically or host memory grows with
         total requests served."""
-        done = {
-            gid: s
-            for gid, s in self.sessions.items()
-            if s.state in (SessionState.FINISHED, SessionState.CANCELLED)
-            and s.slot is None
-        }
-        for gid in done:
-            del self.sessions[gid]
-        return done
+        with self._lock:
+            done = {
+                gid: s
+                for gid, s in self.sessions.items()
+                if s.state in (SessionState.FINISHED, SessionState.CANCELLED)
+                and s.slot is None
+            }
+            for gid in done:
+                del self.sessions[gid]
+            return done
 
     # -- scheduling internals -------------------------------------------------
 

@@ -181,3 +181,18 @@ def test_prompt_args_validation():
     ns = argparse.Namespace(prompt=None, prompt_ids="5, 6,7", model="x")
     ids, tok = _resolve_prompt(ns)
     assert ids == [5, 6, 7] and tok is None
+
+
+def test_local_speculative_matches_plain(tmp_path, capsys):
+    """--speculative-draft (self-drafting) must reproduce plain greedy."""
+    _write_checkpoint(str(tmp_path))
+    base = ["local", "--model", str(tmp_path), "--prompt-ids", "5,11,42",
+            "--max-new", "6", "--dtype", "float32", "--cache", "dense",
+            "--max-seq-len", "64"]
+    assert main(base) == 0
+    plain = json.loads(capsys.readouterr().out)["tokens"]
+    assert main(base + ["--speculative-draft", str(tmp_path),
+                        "--speculative-k", "3"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["tokens"] == plain
+    assert out["speculative"]["proposed"] > 0

@@ -186,3 +186,46 @@ def test_collect_finished_reaps_sessions():
     assert len(eng.sessions) == 3
     done = eng.collect_finished()
     assert len(done) == 3 and len(eng.sessions) == 0
+
+
+def test_concurrent_submit_while_stepping():
+    """SURVEY §5.2: request threads submit/cancel while a server thread
+    steps; every session must finish with its solo-run tokens."""
+    import threading
+
+    eng = make_engine(kind="paged", batch=3)
+    solo = {}
+    for i, p in enumerate(prompts(12, seed=21)):
+        ref_eng = make_engine(kind="paged", batch=3)
+        solo[i] = (p, ref_eng.generate([p], SamplingOptions(max_new_tokens=6))[0])
+
+    ids = {}
+    ids_lock = threading.Lock()
+
+    def producer(lo, hi):
+        for i in range(lo, hi):
+            gid = eng.submit(solo[i][0], SamplingOptions(max_new_tokens=6))
+            with ids_lock:
+                ids[i] = gid
+
+    threads = [threading.Thread(target=producer, args=(i * 4, (i + 1) * 4))
+               for i in range(3)]
+    stop = threading.Event()
+
+    def server():
+        while not stop.is_set() or eng.has_work():
+            eng.step()
+
+    srv = threading.Thread(target=server)
+    srv.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    srv.join(timeout=120)
+    assert not srv.is_alive()
+
+    for i, (prompt, expect) in solo.items():
+        got = eng.sessions[ids[i]].generated
+        assert got == expect, (i, got, expect)
