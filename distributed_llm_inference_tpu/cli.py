@@ -173,6 +173,9 @@ def cmd_local(args) -> int:
         if args.quantize or args.int8:
             raise SystemExit("--speculative-draft does not support weight "
                              "quantization yet")
+        if args.cache != "paged" or args.max_sessions != 8:
+            raise SystemExit("--speculative-draft runs bs=1 with its own "
+                             "dense caches; remove --cache/--max-sessions")
     cfg = checkpoint.load_config(args.model)
     params = checkpoint.load_model_params(
         args.model, cfg, jnp.dtype(args.dtype), cache_dir=args.weights_cache

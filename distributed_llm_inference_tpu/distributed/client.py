@@ -14,6 +14,7 @@ of the reference's multi-tenant cache design (``models/llama/model.py:27`` →
 
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Dict, List, Optional, Sequence
 
@@ -27,7 +28,21 @@ from .directory import DirectoryClient
 from .messages import pack_frame, unpack_frame
 from .relay import RelayClient
 
-__all__ = ["DistributedClient"]
+__all__ = ["DistributedClient", "WorkerError"]
+
+
+class WorkerError(RuntimeError):
+    """An error frame reported by a block worker.
+
+    ``retryable`` is True when the condition indicates session loss (worker
+    restarted / session evicted — ``KeyError: unknown generation`` from
+    ``backend.py``), i.e. a replay on a fresh route can succeed; deterministic
+    worker errors (bad request shapes, capacity) are not retried.
+    """
+
+    def __init__(self, message: str, retryable: bool):
+        super().__init__(message)
+        self.retryable = retryable
 
 
 class DistributedClient:
@@ -52,9 +67,9 @@ class DistributedClient:
         self.dtype = jnp.dtype(dtype)
         self.prefill_buckets = tuple(prefill_buckets)
         self.host, self.relay_port = host, relay_port
-        self.reply_queue = f"client.{uuid.uuid4().hex[:12]}"
         self._relay = RelayClient(host, relay_port)
         self._directory = DirectoryClient(relay_port, host)
+        self.failovers = 0  # mid-generation re-route count (observability)
 
         self._embed = jax.jit(
             lambda emb, t: jnp.take(emb, t, axis=0).astype(self.dtype)
@@ -81,16 +96,18 @@ class DistributedClient:
         )
 
     def _send_through(self, route, gen_id: str, x: np.ndarray, num_new: int,
-                      timeout: float, new: bool = False) -> np.ndarray:
-        hops = [n["queue"] for n in route[1:]] + [self.reply_queue]
+                      timeout: float, reply_queue: str,
+                      new: bool = False) -> np.ndarray:
+        hops = [n["queue"] for n in route[1:]] + [reply_queue]
         header = {"op": "forward", "gen_id": gen_id, "num_new": num_new,
                   "hops": hops, "new": new}
         self._relay.put(route[0]["queue"], pack_frame(header, np.asarray(x)))
-        reply_header, y = unpack_frame(self._relay.get(self.reply_queue,
+        reply_header, y = unpack_frame(self._relay.get(reply_queue,
                                                        timeout=timeout))
         if reply_header.get("op") == "error":
-            raise RuntimeError(
-                f"worker {reply_header.get('from')}: {reply_header['error']}"
+            msg = f"worker {reply_header.get('from')}: {reply_header['error']}"
+            raise WorkerError(
+                msg, retryable="unknown generation" in reply_header["error"]
             )
         if reply_header.get("gen_id") != gen_id:
             raise RuntimeError("out-of-order reply (concurrent use of one "
@@ -98,10 +115,28 @@ class DistributedClient:
         return y
 
     def _end_session(self, route, gen_id: str) -> None:
+        """Best-effort: surviving nodes free the session's cache row; dead
+        nodes/relays are ignored (their rows age out with the node)."""
         for node in route:
-            self._relay.put(node["queue"], pack_frame(
-                {"op": "end", "gen_id": gen_id}
-            ))
+            try:
+                self._relay.put(node["queue"], pack_frame(
+                    {"op": "end", "gen_id": gen_id}
+                ))
+            except Exception:
+                pass
+
+    def _await_route(self, deadline: float) -> None:
+        """Poll the directory until some chain covers all layers again (a
+        replacement node's registration is what ends the wait). The attempt
+        re-plans for itself — routes can change between poll and use."""
+        while True:
+            try:
+                self.plan_route()
+                return
+            except LookupError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
 
     # -- generation -----------------------------------------------------------
 
@@ -111,30 +146,87 @@ class DistributedClient:
         max_new_tokens: int = 16,
         eos_token_id: Optional[int] = None,
         timeout: float = 60.0,
+        max_retries: int = 2,
+        reroute_wait: float = 15.0,
     ) -> List[int]:
-        """Greedy decode of one prompt through the remote chain."""
+        """Greedy decode of one prompt through the remote chain.
+
+        Mid-generation failover (SURVEY §5.3): if a hop dies (reply timeout /
+        worker error), the client waits for the directory to route around the
+        loss, then REPLAYS the session on the new chain — re-prefilling
+        ``prompt + tokens so far`` under a fresh ``generation_id`` (greedy
+        decoding is deterministic, so the replayed stream continues exactly;
+        inference needs no optimizer state — recovery is reload + replay).
+        """
         if not len(prompt):
             raise ValueError("empty prompt")
-        route = self.plan_route()
-        gen_id = f"gen-{uuid.uuid4().hex[:12]}"
-        try:
-            # Prefill: embed the padded prompt, push through the chain.
-            n = len(prompt)
+        out: List[int] = []
+        failures = 0
+        while True:
+            try:
+                return self._generate_attempt(
+                    list(prompt), out, max_new_tokens, eos_token_id, timeout
+                )
+            except (TimeoutError, RuntimeError) as e:
+                if isinstance(e, WorkerError) and not e.retryable:
+                    raise  # deterministic worker error: replay cannot help
+                failures += 1
+                self.failovers += 1
+                if failures > max_retries:
+                    raise
+                self._await_route(time.monotonic() + reroute_wait)
+
+    def _prefill_chunks(self, route, gen_id, tokens, timeout, reply_queue):
+        """Push ``tokens`` through the chain in bucket-sized chunks (the
+        first with ``new=True``); returns ``(last chunk's hidden states,
+        index of the last valid position in that chunk)``."""
+        cap = self.prefill_buckets[-1]
+        chunks = [tokens[i : i + cap] for i in range(0, len(tokens), cap)]
+        y, last_n = None, 0
+        for ci, chunk in enumerate(chunks):
+            n = len(chunk)
             bucket = self._bucket(n)
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, :n] = np.asarray(prompt, np.int32)
+            padded[0, :n] = np.asarray(chunk, np.int32)
             x = self._embed(self.params["embed"], jnp.asarray(padded))
             y = self._send_through(route, gen_id, np.asarray(x), n, timeout,
-                                   new=True)
-            logits = self._head_last(self.params, jnp.asarray(y), n - 1)
-            token = int(jnp.argmax(logits[0, -1]))
-            out = [token]
+                                   reply_queue, new=(ci == 0))
+            last_n = n
+        return y, last_n
+
+    def _generate_attempt(
+        self, prompt, out: List[int], max_new_tokens, eos_token_id, timeout
+    ) -> List[int]:
+        """One route's worth of progress; ``out`` persists across attempts."""
+        if out and (len(out) >= max_new_tokens or out[-1] == eos_token_id):
+            return out  # the failed hop was already past the last token
+        route = self.plan_route()
+        gen_id = f"gen-{uuid.uuid4().hex[:12]}"
+        # Per-attempt reply queue: a late reply from a slow (not dead) old
+        # route must not land in the new attempt's stream.
+        reply_queue = f"client.{uuid.uuid4().hex[:12]}"
+        try:
+            # (Re-)prefill: the prompt plus all but the newest generated
+            # token (the newest is not in any cache yet — it is fed as the
+            # first decode step below). Chunked, so a replay longer than one
+            # bucket (long generation before the failure) still fits.
+            replay = prompt + out[:-1]
+            y, last_n = self._prefill_chunks(
+                route, gen_id, replay, timeout, reply_queue
+            )
+            if out:
+                token = out[-1]
+            else:
+                logits = self._head_last(self.params, jnp.asarray(y), last_n - 1)
+                token = int(jnp.argmax(logits[0, -1]))
+                out.append(token)
             # Decode loop: one hidden-state hop per token.
             while len(out) < max_new_tokens and token != eos_token_id:
                 x = self._embed(
                     self.params["embed"], jnp.asarray([[token]], jnp.int32)
                 )
-                y = self._send_through(route, gen_id, np.asarray(x), 1, timeout)
+                y = self._send_through(route, gen_id, np.asarray(x), 1,
+                                       timeout, reply_queue)
                 logits = self._head_last(self.params, jnp.asarray(y), 0)
                 token = int(jnp.argmax(logits[0, -1]))
                 out.append(token)

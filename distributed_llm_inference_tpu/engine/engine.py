@@ -163,6 +163,9 @@ class InferenceEngine:
 
     def submit(self, prompt: Sequence[int], options: Optional[SamplingOptions] = None) -> str:
         """Queue a prompt; returns its generation_id. Thread-safe."""
+        return self._submit_session(prompt, options).generation_id
+
+    def _submit_session(self, prompt, options) -> Session:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         s = Session(prompt=list(prompt), options=options or SamplingOptions())
@@ -170,7 +173,7 @@ class InferenceEngine:
             self.sessions[s.generation_id] = s
             self.waiting.append(s)
         self.metrics.counter("sessions_submitted")
-        return s.generation_id
+        return s
 
     def cancel(self, generation_id: str) -> None:
         """Thread-safe."""
@@ -206,10 +209,10 @@ class InferenceEngine:
         max_steps: int = 100_000,
     ) -> List[List[int]]:
         """Blocking convenience API: run all prompts to completion."""
-        ids = [self.submit(p, options) for p in prompts]
-        with self._lock:  # hold Session objects: a concurrent
-            subs = [self.sessions[i] for i in ids]  # collect_finished() may
-        for _ in range(max_steps):                  # reap the dict entries
+        # Hold the Session objects themselves: a concurrent
+        # collect_finished() may reap the dict entries at any point.
+        subs = [self._submit_session(p, options) for p in prompts]
+        for _ in range(max_steps):
             if not self.has_work():
                 break
             self.step()

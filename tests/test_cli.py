@@ -187,10 +187,10 @@ def test_local_speculative_matches_plain(tmp_path, capsys):
     """--speculative-draft (self-drafting) must reproduce plain greedy."""
     _write_checkpoint(str(tmp_path))
     base = ["local", "--model", str(tmp_path), "--prompt-ids", "5,11,42",
-            "--max-new", "6", "--dtype", "float32", "--cache", "dense",
-            "--max-seq-len", "64"]
-    assert main(base) == 0
+            "--max-new", "6", "--dtype", "float32", "--max-seq-len", "64"]
+    assert main(base + ["--cache", "dense"]) == 0
     plain = json.loads(capsys.readouterr().out)["tokens"]
+    # Speculative path: flags for the engine cache are rejected, so none here.
     assert main(base + ["--speculative-draft", str(tmp_path),
                         "--speculative-k", "3"]) == 0
     out = json.loads(capsys.readouterr().out)

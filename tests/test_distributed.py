@@ -214,3 +214,63 @@ def test_unknown_session_error_reaches_client(cluster, params):
         reply, _ = unpack_frame(c.get("reply.ghost", timeout=10))
     assert reply["op"] == "error"
     assert "ghost" in reply["error"]
+
+
+def test_midstream_node_death_reroute_and_replay(cluster, params):
+    """SURVEY §5.3: a node dies MID-generation; a replacement registers; the
+    client re-routes and replays, and the final stream is identical to an
+    uninterrupted run."""
+    import threading
+
+    relay, service, n1, n2 = cluster
+    prompt = [5, 11, 42]
+    ref = _oracle_greedy(params, prompt, 8)
+
+    replacement = []
+
+    def kill_and_replace():
+        time.sleep(0.8)  # let prefill + a few decode steps happen
+        n2.stop()
+        replacement.append(ServingNode(
+            relay.port, CFG,
+            {k: v[2:4] for k, v in params["layers"].items()}, 2, 3,
+            max_seq_len=64, heartbeat_s=0.5, lease_ttl=3.0, dtype=jnp.float32,
+        ))
+
+    killer = threading.Thread(target=kill_and_replace)
+    with DistributedClient(
+        relay.port, CFG, params, prefill_buckets=(16,), dtype=jnp.float32
+    ) as client:
+        killer.start()
+        try:
+            got = client.generate(
+                prompt, max_new_tokens=8, timeout=4.0, reroute_wait=20.0
+            )
+        finally:
+            killer.join()
+            for node in replacement:
+                node.stop()
+        assert client.failovers >= 1, "node died but no failover happened"
+    assert got == ref
+
+
+def test_failover_gives_up_after_max_retries(cluster, params):
+    relay, service, n1, n2 = cluster
+    n2.stop()  # no replacement will come
+    with DistributedClient(
+        relay.port, CFG, params, prefill_buckets=(16,), dtype=jnp.float32
+    ) as client:
+        with pytest.raises((LookupError, TimeoutError, RuntimeError)):
+            client.generate([5, 11], max_new_tokens=4, timeout=1.0,
+                            max_retries=1, reroute_wait=1.0)
+
+
+def test_prompt_longer_than_bucket_chunked_prefill(cluster, params):
+    """Prompts beyond the largest prefill bucket stream through in chunks."""
+    relay, *_ = cluster
+    prompt = list(np.random.default_rng(3).integers(0, CFG.vocab_size, 19))
+    with DistributedClient(
+        relay.port, CFG, params, prefill_buckets=(8,), dtype=jnp.float32
+    ) as client:
+        got = client.generate(prompt, max_new_tokens=4)
+    assert got == _oracle_greedy(params, prompt, 4)
