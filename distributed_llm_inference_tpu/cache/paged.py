@@ -25,10 +25,13 @@ corrupt another session's pages.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import collections
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..ops.attention import causal_mask
@@ -285,39 +288,115 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
 
 
 class PageAllocator:
-    """Host-side free-list page allocator (page 0 reserved as the null page).
+    """Host-side page allocator (page 0 reserved as the null page) with
+    refcounts and a prompt-prefix registry for automatic prefix caching.
 
     Plays the role hivemind's runtime state played for the reference's server:
     pure Python, not traced — only its *outputs* (page tables) reach the
     device. Guarded by the engine's scheduler lock (SURVEY §5.2).
+
+    Prefix caching (vLLM-style): a page holding a FULL page-sized chunk of a
+    session's prompt is content-addressed by the hash chain of the prompt up
+    to and including that chunk. On release such pages are ``register``-ed
+    instead of freed; a later session with the same prompt prefix ``lookup``s
+    the chain and maps the cached pages into its table read-only (refcounted;
+    writes never touch them — the session's write offset starts past the
+    shared span). Unreferenced registered pages form an LRU that ``alloc``
+    evicts from under pool pressure.
     """
 
     def __init__(self, num_pages: int):
         self._free = list(range(num_pages - 1, 0, -1))  # pop() yields low ids first
         self._free_set = set(self._free)
         self.num_pages = num_pages
+        self._refs: Dict[int, int] = {}
+        self._registry: Dict[bytes, int] = {}      # chain key -> page
+        self._page_key: Dict[int, bytes] = {}      # page -> chain key
+        self._lru: "collections.OrderedDict[int, None]" = collections.OrderedDict()
 
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        """Pages obtainable right now (free list + evictable cached pages)."""
+        return len(self._free) + len(self._lru)
+
+    @staticmethod
+    def chain_keys(tokens, page_size: int) -> List[bytes]:
+        """Hash-chain keys of every FULL page-sized chunk of ``tokens``."""
+        keys, h = [], hashlib.sha1()
+        for i in range(len(tokens) // page_size):
+            chunk = tokens[i * page_size : (i + 1) * page_size]
+            h.update(np.asarray(chunk, np.int64).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def _evict_one(self) -> None:
+        page, _ = self._lru.popitem(last=False)  # oldest
+        key = self._page_key.pop(page)
+        del self._registry[key]
+        del self._refs[page]
+        self._free.append(page)
+        self._free_set.add(page)
 
     def alloc(self, n: int):
+        """n fresh (private, refcount-1) pages; evicts cached pages if needed."""
+        while len(self._free) < n and self._lru:
+            self._evict_one()
         if n > len(self._free):
             raise MemoryError(
                 f"page pool exhausted: want {n}, have {len(self._free)}"
             )
         pages = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
+    def lookup(self, keys: Sequence[bytes]) -> List[int]:
+        """Longest cached run of prefix pages for ``keys``; each returned
+        page's refcount is incremented (caller owns a reference)."""
+        pages: List[int] = []
+        for key in keys:
+            page = self._registry.get(key)
+            if page is None:
+                break
+            self._refs[page] += 1
+            self._lru.pop(page, None)  # referenced: not evictable
+            pages.append(page)
+        return pages
+
+    def register(self, page: int, key: bytes) -> None:
+        """Content-address ``page`` (a full prompt-prefix page) under ``key``.
+        If ``key`` is already registered to a different page, the existing
+        entry wins (first writer; duplicates just stay private)."""
+        if key in self._registry or page in self._page_key:
+            return
+        self._registry[key] = page
+        self._page_key[page] = key
+
     def free(self, pages) -> None:
-        for p in pages:
+        """Drop one reference per page; unreferenced pages return to the free
+        list, or to the evictable LRU if they are registered prefixes.
+
+        Iterates in REVERSE so a prefix chain's deepest chunks enter the LRU
+        first (oldest): eviction then trims chains from the tail, keeping a
+        usable shorter prefix — evicting the chain root first would orphan
+        every deeper cached page."""
+        for p in reversed(list(pages)):
             if not 0 < p < self.num_pages:
                 raise ValueError(
                     f"page {p} outside pool (1..{self.num_pages - 1}; 0 is the "
                     "reserved null page)"
                 )
-            if p in self._free_set:
+            refs = self._refs.get(p)
+            if refs is None or refs == 0 or p in self._free_set:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
-            self._free_set.add(p)
+            if refs > 1:
+                self._refs[p] = refs - 1
+                continue
+            if p in self._page_key:  # cached prefix: evictable, not freed
+                self._lru[p] = None
+                self._refs[p] = 0
+            else:
+                del self._refs[p]
+                self._free.append(p)
+                self._free_set.add(p)

@@ -153,6 +153,10 @@ class CacheConfig:
     page_size: int = 64
     num_pages: int = 512
     max_pages_per_session: int = 64
+    # Automatic prefix caching (paged kind): finished sessions' full prompt
+    # pages are content-addressed; new sessions sharing a prompt prefix map
+    # the cached pages instead of recomputing their KV.
+    prefix_caching: bool = False
     # sink-cache policy (kind == "sink")
     window_length: int = 1024
     num_sink_tokens: int = 4

@@ -280,24 +280,46 @@ class InferenceEngine:
             # Reset the row BEFORE installing pages (reset wipes the row's
             # page table).
             self.cache = self.cache.reset_rows(jnp.arange(self.batch) == slot)
+            shared_len = 0
             if isinstance(self.cache, PagedKVCache):
-                need = math.ceil((len(s.prompt) + 1) / self.ccfg.page_size)
-                if need > self.allocator.free_count:
+                ps = self.ccfg.page_size
+                need = math.ceil((len(s.prompt) + 1) / ps)
+                shared = []
+                if self.ccfg.prefix_caching:
+                    # Share cached prompt-prefix pages, capped so the LAST
+                    # prompt token is always computed (its logits seed the
+                    # first sampled token).
+                    if s.prefix_keys is None:
+                        s.prefix_keys = PageAllocator.chain_keys(s.prompt, ps)
+                    shared = self.allocator.lookup(
+                        s.prefix_keys[: (len(s.prompt) - 1) // ps]
+                    )
+                if need - len(shared) > self.allocator.free_count:
+                    if shared:
+                        self.allocator.free(shared)  # return the refs
                     break  # pool pressure: hold the queue, retry next tick
-                s.pages = self.allocator.alloc(need)
+                s.pages = shared + self.allocator.alloc(need - len(shared))
                 self.cache = self.cache.assign_pages(slot, s.pages)
+                shared_len = len(shared) * ps
+                if shared_len:
+                    self.cache = self.cache.replace(
+                        lengths=self.cache.lengths.at[slot].set(shared_len)
+                    )
+                    self.metrics.counter("prefix_cached_tokens", shared_len)
             self.waiting.popleft()
             s.slot = slot
             s.state = SessionState.ACTIVE
             self.slots[slot] = s.generation_id
-            self._run_prefill(s, produced)
+            self._run_prefill(s, produced, skip=shared_len)
 
-    def _run_prefill(self, s: Session, produced) -> None:
+    def _run_prefill(self, s: Session, produced, skip: int = 0) -> None:
         """Chunked, bucketed prefill of one admitted session; samples the
-        first generated token from the final chunk."""
+        first generated token from the final chunk. ``skip`` tokens at the
+        head are already in the cache (shared prefix pages) — the row's
+        write offset (``lengths``) was set past them at admission."""
         chunk_cap = self._max_chunk()
         prompt = np.asarray(s.prompt, np.int32)
-        offset = 0
+        offset = skip
         with self.metrics.timer("prefill"), span(
             "prefill", self.spans,
             generation_id=s.generation_id, prompt_tokens=len(s.prompt),
@@ -321,7 +343,7 @@ class InferenceEngine:
                 jnp.int32(len(rest)), self._next_key(), sp,
             )
         self._deliver(s, int(token), produced)
-        self.metrics.counter("prefill_tokens", len(s.prompt))
+        self.metrics.counter("prefill_tokens", len(s.prompt) - skip)
 
     def _decode_tick(self, produced) -> None:
         tokens = np.zeros((self.batch, 1), np.int32)
@@ -407,5 +429,16 @@ class InferenceEngine:
             self.slots[s.slot] = None
             s.slot = None
         if isinstance(self.cache, PagedKVCache) and s.pages:
+            if self.ccfg.prefix_caching:
+                # Content-address the pages fully covered by PROMPT tokens so
+                # later sessions with the same prefix reuse their KV. Pages
+                # touching generated tokens are position-pure too, but their
+                # content depends on sampling — only prompt pages are shared.
+                ps = self.ccfg.page_size
+                if s.prefix_keys is None:
+                    s.prefix_keys = PageAllocator.chain_keys(s.prompt, ps)
+                for i, key in enumerate(s.prefix_keys):
+                    if i < len(s.pages):
+                        self.allocator.register(s.pages[i], key)
             self.allocator.free(s.pages)
             s.pages = []

@@ -39,6 +39,9 @@ class Session:
     pages: List[int] = dataclasses.field(default_factory=list)
     generated: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None  # "eos" | "length" | "capacity" | "cancelled"
+    # Memoized prompt-prefix chain keys (prefix caching; computed once even
+    # when pool pressure re-runs admission over many ticks).
+    prefix_keys: Optional[List[bytes]] = None
     # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

@@ -1,0 +1,139 @@
+"""Automatic prefix caching (paged cache): allocator refcount/registry
+invariants and engine-level correctness — shared prefixes must change
+prefill work, never tokens."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.cache.paged import PageAllocator
+from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig, ModelConfig
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+# -- allocator ---------------------------------------------------------------
+
+
+def test_chain_keys_full_chunks_only():
+    keys = PageAllocator.chain_keys(list(range(19)), 8)
+    assert len(keys) == 2
+    # Chain: same first chunk -> same first key; divergence changes the rest.
+    other = PageAllocator.chain_keys(list(range(8)) + [99] * 8, 8)
+    assert other[0] == keys[0] and other[1] != keys[1]
+
+
+def test_register_lookup_refcount_evict():
+    a = PageAllocator(6)  # pages 1..5
+    pages = a.alloc(2)
+    keys = PageAllocator.chain_keys(list(range(16)), 8)
+    a.register(pages[0], keys[0])
+    a.register(pages[1], keys[1])
+    a.free(pages)  # refcount 0 -> evictable LRU, still registered
+    assert a.free_count == 5  # 3 free + 2 evictable
+
+    got = a.lookup(keys)
+    assert got == pages  # full chain hit, refs taken
+    a.free(got)
+
+    # Pool pressure evicts the cached pages.
+    grabbed = a.alloc(5)
+    assert set(grabbed) == {1, 2, 3, 4, 5}
+    assert a.lookup(keys) == []  # registry emptied by eviction
+    a.free(grabbed)
+
+
+def test_lookup_partial_chain():
+    a = PageAllocator(6)
+    pages = a.alloc(1)
+    keys = PageAllocator.chain_keys(list(range(24)), 8)
+    a.register(pages[0], keys[0])
+    a.free(pages)
+    assert a.lookup(keys) == pages  # only the first page is cached
+    a.free(pages)
+
+
+def test_double_free_detected():
+    a = PageAllocator(4)
+    p = a.alloc(1)
+    a.free(p)
+    with pytest.raises(ValueError):
+        a.free(p)
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def _engine(prefix_caching, num_pages=64):
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=4, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="paged", page_size=8, num_pages=num_pages,
+                    max_pages_per_session=8, prefix_caching=prefix_caching),
+    )
+
+
+PROMPT = list(np.random.default_rng(0).integers(0, CFG.vocab_size, 21))
+
+
+def test_prefix_hit_skips_prefill_and_matches():
+    eng = _engine(True)
+    first = eng.generate([PROMPT], SamplingOptions(max_new_tokens=6))[0]
+    eng.collect_finished()
+    snap0 = eng.metrics.snapshot()
+    assert snap0.get("prefix_cached_tokens", 0) == 0
+
+    second = eng.generate([PROMPT], SamplingOptions(max_new_tokens=6))[0]
+    snap = eng.metrics.snapshot()
+    # 21 tokens, page 8 -> 2 full prompt pages = 16 tokens shared.
+    assert snap["prefix_cached_tokens"] == 16
+    assert second == first
+
+    ref = _engine(False).generate([PROMPT], SamplingOptions(max_new_tokens=6))[0]
+    assert second == ref
+
+
+def test_prefix_sharing_between_live_sessions():
+    """Two sessions sharing a cached prefix decode concurrently without
+    corrupting each other (shared pages are never written)."""
+    eng = _engine(True)
+    eng.generate([PROMPT], SamplingOptions(max_new_tokens=2))
+    outs = eng.generate([PROMPT, PROMPT, PROMPT[:13]],
+                        SamplingOptions(max_new_tokens=6))
+    ref = _engine(False).generate([PROMPT, PROMPT, PROMPT[:13]],
+                                  SamplingOptions(max_new_tokens=6))
+    assert outs == ref
+
+
+def test_divergent_prompts_do_not_cross_hit():
+    eng = _engine(True)
+    other = PROMPT[:8] + [(t + 1) % CFG.vocab_size for t in PROMPT[8:]]
+    a = eng.generate([PROMPT], SamplingOptions(max_new_tokens=4))[0]
+    b = eng.generate([other], SamplingOptions(max_new_tokens=4))[0]
+    snap = eng.metrics.snapshot()
+    # Second prompt shares exactly one page (first 8 tokens).
+    assert snap["prefix_cached_tokens"] == 8
+    ref_b = _engine(False).generate([other], SamplingOptions(max_new_tokens=4))[0]
+    assert b == ref_b
+
+
+def test_eviction_under_pool_pressure_stays_correct():
+    eng = _engine(True, num_pages=24)  # tight pool forces eviction cycles
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(0, CFG.vocab_size, int(rng.integers(9, 22))))
+               for _ in range(10)]
+    outs = [eng.generate([p], SamplingOptions(max_new_tokens=4))[0]
+            for p in prompts]
+    plain = _engine(False, num_pages=24)
+    refs = [plain.generate([p], SamplingOptions(max_new_tokens=4))[0]
+            for p in prompts]
+    assert outs == refs
