@@ -59,7 +59,12 @@ class InferenceEngine:
         cache_cfg: Optional[CacheConfig] = None,
         rng: Optional[jax.Array] = None,
         attention_fn=None,
+        mesh_cfg=None,
     ):
+        """``mesh_cfg`` (a :class:`MeshConfig`, model-parallel axes only —
+        tp/ep) serves one model replica sharded across chips: params and
+        cache get their NamedShardings and GSPMD partitions every jitted
+        step; the scheduler is untouched (batch rows stay replicated)."""
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
         if self.ecfg.quantization in ("int8", "int4"):
@@ -92,6 +97,10 @@ class InferenceEngine:
                 f"kv_quant={cc.kv_quant!r} is only supported for the dense "
                 f"cache (got kind={cc.kind!r})"
             )
+        if cc.prefix_caching and cc.kind != "paged":
+            raise ValueError(
+                f"prefix_caching requires the paged cache (got kind={cc.kind!r})"
+            )
         if cc.kind == "dense":
             cache_cls = (
                 QuantizedDenseKVCache if cc.kv_quant == "int8" else DenseKVCache
@@ -116,6 +125,27 @@ class InferenceEngine:
             self.allocator = None
         else:
             raise ValueError(f"unknown cache kind {cc.kind}")
+
+        self.mesh = None
+        if mesh_cfg is not None:
+            from ..parallel import (
+                build_mesh, cache_pspecs, param_pspecs, shard_pytree,
+                validate_tp,
+            )
+
+            if mesh_cfg.dp != 1 or mesh_cfg.pp != 1 or mesh_cfg.sp != 1:
+                raise ValueError(
+                    "engine mesh serves ONE replica: only tp/ep axes are "
+                    f"supported here (got {mesh_cfg})"
+                )
+            validate_tp(cfg, mesh_cfg.tp, ep=mesh_cfg.ep)
+            self.mesh = build_mesh(mesh_cfg)
+            self.params = shard_pytree(
+                self.params, self.mesh, param_pspecs(self.params)
+            )
+            self.cache = shard_pytree(
+                self.cache, self.mesh, cache_pspecs(self.cache)
+            )
 
         self.sessions: Dict[str, Session] = {}
         self.waiting: collections.deque[Session] = collections.deque()
@@ -155,9 +185,20 @@ class InferenceEngine:
 
         donate = jax.default_backend() == "tpu"
         dk = dict(donate_argnums=(2,)) if donate else {}
-        self._prefill = jax.jit(_prefill_row, **dk)
-        self._prefill_ns = jax.jit(_prefill_row_nosample, **dk)
-        self._decode = jax.jit(_decode_step, **dk)
+        self._prefill = self._with_mesh(jax.jit(_prefill_row, **dk))
+        self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
+        self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
+
+    def _with_mesh(self, fn):
+        """Run a jitted step inside the mesh context when serving sharded."""
+        if self.mesh is None:
+            return fn
+
+        def go(*a, **k):
+            with self.mesh:
+                return fn(*a, **k)
+
+        return go
 
     # -- public API -----------------------------------------------------------
 

@@ -9,6 +9,7 @@ lengths interleave; pages are reclaimed; sampling controls behave.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig, ModelConfig
 from distributed_llm_inference_tpu.engine.engine import InferenceEngine
@@ -229,3 +230,56 @@ def test_concurrent_submit_while_stepping():
     for i, (prompt, expect) in solo.items():
         got = eng.sessions[ids[i]].generated
         assert got == expect, (i, got, expect)
+
+
+def test_engine_tp_mesh_matches_single_device():
+    """One replica served tp-sharded across the CPU mesh == unsharded."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    reqs = prompts(5, seed=31)
+    plain = make_engine(kind="dense").generate(
+        reqs, SamplingOptions(max_new_tokens=6)
+    )
+    sharded_eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=4, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(tp=2),
+    )
+    assert sharded_eng.generate(reqs, SamplingOptions(max_new_tokens=6)) == plain
+
+
+def test_engine_mesh_rejects_batch_axes():
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    with pytest.raises(ValueError):
+        InferenceEngine(
+            CFG, PARAMS, EngineConfig(max_batch_size=2, dtype="float32"),
+            CacheConfig(kind="dense"), mesh_cfg=MeshConfig(dp=2),
+        )
+
+
+def test_engine_ep_mesh_moe():
+    """Mixtral served with experts sharded over ep == unsharded."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    mcfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, num_experts=4,
+        num_experts_per_tok=2, family="mixtral",
+    )
+    mparams = llama.init_params(mcfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    reqs = prompts(3, seed=5)
+
+    def run(mesh_cfg):
+        eng = InferenceEngine(
+            mcfg, mparams,
+            EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                         max_seq_len=48, dtype="float32"),
+            CacheConfig(kind="dense"),
+            mesh_cfg=mesh_cfg,
+        )
+        return eng.generate(reqs, SamplingOptions(max_new_tokens=5))
+
+    assert run(MeshConfig(ep=2)) == run(None)

@@ -137,3 +137,11 @@ def test_eviction_under_pool_pressure_stays_correct():
     refs = [plain.generate([p], SamplingOptions(max_new_tokens=4))[0]
             for p in prompts]
     assert outs == refs
+
+
+def test_prefix_caching_requires_paged_kind():
+    with pytest.raises(ValueError):
+        InferenceEngine(
+            CFG, PARAMS, EngineConfig(max_batch_size=2, dtype="float32"),
+            CacheConfig(kind="dense", prefix_caching=True),
+        )
