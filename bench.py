@@ -121,8 +121,12 @@ def _zero_q4params(cfg: ModelConfig):
 
 def _try_decode_bench(cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache):
     """Decode throughput at ``batch``: tokens/sec on this one chip."""
+    # Buffer sized to the bucket this workload reaches (ctx//2 live + the
+    # steps generated) — the serving engine's growth ladder does the same:
+    # decode bandwidth tracks live context, with ctx as the virtual cap.
+    buf = min(ctx, ctx // 2 + steps)
     cache = cache_cls.create(
-        cfg.num_layers, batch, ctx, cfg.num_kv_heads, cfg.head_dim
+        cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     num_new = jnp.ones((batch,), jnp.int32)

@@ -172,6 +172,11 @@ class EngineConfig:
     max_new_tokens: int = 512
     dtype: str = "bfloat16"
     quantization: Optional[str] = None  # None | "int8" | "int4"
+    # Decode attention-window buckets (dense cache kinds): each decode step
+    # reads only the smallest bucket >= the longest live row instead of the
+    # full max_seq_len buffer (one executable per bucket; big bandwidth win
+    # early in long-context serving). None = auto ladder; () disables.
+    decode_windows: Optional[Tuple[int, ...]] = None
     use_pallas_attention: bool = False
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled

@@ -90,6 +90,7 @@ class InferenceEngine:
         self.batch = self.ecfg.max_batch_size
         dtype = jnp.dtype(self.ecfg.dtype)
         b, cc = self.batch, self.ccfg
+        self._windows: Tuple[int, ...] = ()
         if cc.kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv_quant {cc.kv_quant!r}")
         if cc.kv_quant is not None and cc.kind != "dense":
@@ -105,8 +106,16 @@ class InferenceEngine:
             cache_cls = (
                 QuantizedDenseKVCache if cc.kv_quant == "int8" else DenseKVCache
             )
+            # Start at the smallest bucket; _ensure_capacity grows the buffer
+            # (one pad-copy per growth) as sequences lengthen. Decode
+            # bandwidth tracks the LIVE context, not max_seq_len: a padded
+            # max-size buffer costs ~30% of decode throughput at 7B shapes
+            # early in long-context serving. Growth re-creates buffers, which
+            # would drop mesh shardings — fixed-size when serving sharded.
+            self._windows = () if mesh_cfg is not None else self._window_ladder()
+            first = self._windows[0] if self._windows else self.ecfg.max_seq_len
             self.cache = cache_cls.create(
-                cfg.num_layers, b, self.ecfg.max_seq_len, cfg.num_kv_heads,
+                cfg.num_layers, b, first, cfg.num_kv_heads,
                 cfg.head_dim, dtype,
             )
             self.allocator = None
@@ -151,6 +160,8 @@ class InferenceEngine:
         self.waiting: collections.deque[Session] = collections.deque()
         self.slots: List[Optional[str]] = [None] * self.batch
 
+
+
         attention = attention_fn
         if attention is None and self.ecfg.use_pallas_attention:
             from ..ops.flash_attention import flash_attention
@@ -188,6 +199,59 @@ class InferenceEngine:
         self._prefill = self._with_mesh(jax.jit(_prefill_row, **dk))
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
+
+    def _window_ladder(self) -> Tuple[int, ...]:
+        """Buffer-size buckets: ~1.25x geometric, 32-aligned, capped at
+        max_seq_len. () disables growth (fixed max-size buffer)."""
+        if self.ecfg.decode_windows is not None:
+            if not self.ecfg.decode_windows:
+                return ()  # explicit opt-out: fixed max-size buffer
+            if any(w <= 0 for w in self.ecfg.decode_windows):
+                raise ValueError(
+                    f"decode_windows must be positive: {self.ecfg.decode_windows}"
+                )
+            ws = tuple(sorted(
+                w for w in self.ecfg.decode_windows
+                if w <= self.ecfg.max_seq_len
+            ))
+            if not ws:
+                raise ValueError(
+                    f"every decode_windows entry exceeds max_seq_len="
+                    f"{self.ecfg.max_seq_len}: {self.ecfg.decode_windows}"
+                )
+            if ws[-1] != self.ecfg.max_seq_len:
+                ws = ws + (self.ecfg.max_seq_len,)
+            return ws
+        ws, w = [], 32
+        while w < self.ecfg.max_seq_len:
+            ws.append(w)
+            nxt = ((int(w * 1.25) + 31) // 32) * 32
+            w = nxt if nxt > w else w + 32
+        ws.append(self.ecfg.max_seq_len)
+        return tuple(ws)
+
+    def _ensure_capacity(self, needed_len: int) -> None:
+        """Grow the dense cache buffer to the smallest bucket covering
+        ``needed_len`` (zero-pad copy; per-bucket executables compile once)."""
+        if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
+            return
+        t = self.cache.max_len
+        if needed_len <= t or not self._windows:
+            return
+        new_t = next(
+            (w for w in self._windows if w >= needed_len),
+            self.ecfg.max_seq_len,
+        )
+        pad = new_t - t
+
+        def grow(a):  # time axis is 2 on every layer-stacked buffer
+            widths = [(0, 0)] * a.ndim
+            widths[2] = (0, pad)
+            return jnp.pad(a, widths)
+
+        stacks = tuple(grow(a) for a in self.cache.layer_stacks)
+        self.cache = self.cache.with_layer_stacks(*stacks)
+        self.metrics.counter("cache_growths")
 
     def _with_mesh(self, fn):
         """Run a jitted step inside the mesh context when serving sharded."""
@@ -305,7 +369,24 @@ class InferenceEngine:
         )
         return len(s.prompt) + 1 <= limit
 
+    def _shrink_if_idle(self) -> None:
+        """With no resident sessions, re-create the dense buffer at the
+        smallest bucket (nothing to copy) — one long-context session must not
+        pin its high-water-mark buffer (and its decode bandwidth cost) for
+        the rest of the process. Shapes revisited later hit the jit cache."""
+        if not self._windows or any(g is not None for g in self.slots):
+            return
+        if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
+            return
+        if self.cache.max_len > self._windows[0]:
+            self.cache = type(self.cache).create(
+                self.cfg.num_layers, self.batch, self._windows[0],
+                self.cfg.num_kv_heads, self.cfg.head_dim,
+                jnp.dtype(self.ecfg.dtype),
+            )
+
     def _admit(self, produced) -> None:
+        self._shrink_if_idle()
         for slot in range(self.batch):
             if self.slots[slot] is not None or not self.waiting:
                 continue
@@ -318,6 +399,7 @@ class InferenceEngine:
                 self._finish(s, "capacity", produced)
                 self.metrics.counter("sessions_rejected")
                 continue
+            self._ensure_capacity(len(s.prompt) + 1)
             # Reset the row BEFORE installing pages (reset wipes the row's
             # page table).
             self.cache = self.cache.reset_rows(jnp.arange(self.batch) == slot)
@@ -428,6 +510,11 @@ class InferenceEngine:
         )
         if not active.any():
             return
+
+        if self._windows:
+            self._ensure_capacity(1 + max(
+                self.sessions[g].total_len for g in self.slots if g is not None
+            ))
 
         sp = SamplingParams.stack(opts)
         with self.metrics.timer("decode_step"), span(

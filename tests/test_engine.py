@@ -283,3 +283,77 @@ def test_engine_ep_mesh_moe():
         return eng.generate(reqs, SamplingOptions(max_new_tokens=5))
 
     assert run(MeshConfig(ep=2)) == run(None)
+
+
+def test_decode_windows_do_not_change_tokens():
+    """Window bucketing is a bandwidth optimization only: streams must be
+    identical with windows on (default ladder), custom, and off."""
+    reqs = prompts(6, seed=41)
+
+    def run(decode_windows):
+        eng = InferenceEngine(
+            CFG, PARAMS,
+            EngineConfig(max_batch_size=3, prefill_buckets=(8, 16, 32),
+                         max_seq_len=64, dtype="float32",
+                         decode_windows=decode_windows),
+            CacheConfig(kind="dense"),
+        )
+        return eng.generate(reqs, SamplingOptions(max_new_tokens=9))
+
+    off = run(())
+    assert run(None) == off            # auto ladder
+    assert run((16, 40, 64)) == off    # custom buckets
+    # And for the quantized cache.
+    eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=3, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="dense", kv_quant="int8"),
+    )
+    q_on = eng.generate(reqs, SamplingOptions(max_new_tokens=9))
+    eng2 = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=3, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32", decode_windows=()),
+        CacheConfig(kind="dense", kv_quant="int8"),
+    )
+    assert q_on == eng2.generate(reqs, SamplingOptions(max_new_tokens=9))
+
+
+def test_cache_growth_and_idle_shrink():
+    eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8, 32), max_seq_len=64,
+                     dtype="float32"),
+        CacheConfig(kind="dense"),
+    )
+    first_bucket = eng._windows[0]
+    assert eng.cache.max_len == first_bucket
+    long_prompt = prompts(1, lo=30, hi=31, seed=50)[0]
+    out = eng.generate([long_prompt], SamplingOptions(max_new_tokens=10))[0]
+    assert len(out) == 10
+    assert eng.metrics.snapshot().get("cache_growths", 0) >= 1
+    grown = eng.cache.max_len
+    assert grown >= 41
+    # Next admission with everything idle shrinks back to the first bucket
+    # (then regrows as needed for the new prompt).
+    eng.generate([prompts(1, lo=3, hi=4, seed=51)[0]],
+                 SamplingOptions(max_new_tokens=2))
+    assert eng.cache.max_len < grown
+
+
+def test_decode_windows_validation():
+    with pytest.raises(ValueError):
+        InferenceEngine(
+            CFG, PARAMS,
+            EngineConfig(max_batch_size=2, max_seq_len=64, dtype="float32",
+                         decode_windows=(128, 256)),
+            CacheConfig(kind="dense"),
+        )
+    with pytest.raises(ValueError):
+        InferenceEngine(
+            CFG, PARAMS,
+            EngineConfig(max_batch_size=2, max_seq_len=64, dtype="float32",
+                         decode_windows=(-32, 64)),
+            CacheConfig(kind="dense"),
+        )
