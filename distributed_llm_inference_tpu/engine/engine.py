@@ -120,9 +120,24 @@ class InferenceEngine:
             )
             self.allocator = None
         elif cc.kind == "paged":
+            # The gather path materializes [B, table_width * page_size, ...]
+            # per layer, so decode traffic tracks the TABLE WIDTH, not the
+            # live length. Start narrow and pad columns as sessions lengthen
+            # (cheap: the table is tiny and the pool never moves);
+            # max_pages_per_session is the virtual cap.
+            self._windows = () if mesh_cfg is not None else self._window_ladder(
+                cap=min(self.ecfg.max_seq_len,
+                        cc.max_pages_per_session * cc.page_size),
+                strict=False,  # a small paged capacity caps dense-tuned
+                               # ladders rather than rejecting them
+            )
+            self._first_slots = (
+                max(1, -(-self._windows[0] // cc.page_size))
+                if self._windows else cc.max_pages_per_session
+            )
             self.cache = PagedKVCache.create(
                 cfg.num_layers, b, cc.num_pages, cc.page_size,
-                cc.max_pages_per_session, cfg.num_kv_heads, cfg.head_dim, dtype,
+                self._first_slots, cfg.num_kv_heads, cfg.head_dim, dtype,
                 use_kernel=self.ecfg.use_pallas_attention,
             )
             self.allocator = PageAllocator(cc.num_pages)
@@ -200,9 +215,14 @@ class InferenceEngine:
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
 
-    def _window_ladder(self) -> Tuple[int, ...]:
+    def _window_ladder(
+        self, cap: Optional[int] = None, strict: bool = True
+    ) -> Tuple[int, ...]:
         """Buffer-size buckets: ~1.25x geometric, 32-aligned, capped at
-        max_seq_len. () disables growth (fixed max-size buffer)."""
+        ``cap`` (default max_seq_len). () disables growth (fixed buffers).
+        ``strict`` rejects a custom ladder that lies entirely above ``cap``;
+        non-strict callers just get ``(cap,)``."""
+        cap = cap if cap is not None else self.ecfg.max_seq_len
         if self.ecfg.decode_windows is not None:
             if not self.ecfg.decode_windows:
                 return ()  # explicit opt-out: fixed max-size buffer
@@ -211,33 +231,53 @@ class InferenceEngine:
                     f"decode_windows must be positive: {self.ecfg.decode_windows}"
                 )
             ws = tuple(sorted(
-                w for w in self.ecfg.decode_windows
-                if w <= self.ecfg.max_seq_len
+                w for w in self.ecfg.decode_windows if w <= cap
             ))
             if not ws:
-                raise ValueError(
-                    f"every decode_windows entry exceeds max_seq_len="
-                    f"{self.ecfg.max_seq_len}: {self.ecfg.decode_windows}"
-                )
-            if ws[-1] != self.ecfg.max_seq_len:
-                ws = ws + (self.ecfg.max_seq_len,)
+                if strict:
+                    raise ValueError(
+                        f"every decode_windows entry exceeds the cache "
+                        f"capacity {cap}: {self.ecfg.decode_windows}"
+                    )
+                return (cap,)
+            if ws[-1] != cap:
+                ws = ws + (cap,)
             return ws
         ws, w = [], 32
-        while w < self.ecfg.max_seq_len:
+        while w < cap:
             ws.append(w)
             nxt = ((int(w * 1.25) + 31) // 32) * 32
             w = nxt if nxt > w else w + 32
-        ws.append(self.ecfg.max_seq_len)
+        ws.append(cap)
         return tuple(ws)
 
     def _ensure_capacity(self, needed_len: int) -> None:
-        """Grow the dense cache buffer to the smallest bucket covering
-        ``needed_len`` (zero-pad copy; per-bucket executables compile once)."""
+        """Grow the cache's attended span to the smallest bucket covering
+        ``needed_len``: dense kinds zero-pad-copy their buffers; the paged
+        kind just pads TABLE columns (the pool never moves). Per-bucket
+        executables compile once."""
+        if not self._windows or needed_len <= self.cache.max_len:
+            return
+        if isinstance(self.cache, PagedKVCache):
+            ps = self.ccfg.page_size
+            slots_needed = -(-needed_len // ps)
+            # Ladder entries never exceed max_pages_per_session * page_size
+            # (the __init__ cap), so each candidate slot count is in range.
+            new_slots = next(
+                (-(-w // ps) for w in self._windows
+                 if -(-w // ps) >= slots_needed),
+                self.ccfg.max_pages_per_session,
+            )
+            pad = new_slots - self.cache.page_table.shape[1]
+            if pad > 0:
+                self.cache = self.cache.replace(page_table=jnp.pad(
+                    self.cache.page_table, ((0, 0), (0, pad))
+                ))
+                self.metrics.counter("cache_growths")
+            return
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             return
         t = self.cache.max_len
-        if needed_len <= t or not self._windows:
-            return
         new_t = next(
             (w for w in self._windows if w >= needed_len),
             self.ecfg.max_seq_len,
@@ -376,6 +416,16 @@ class InferenceEngine:
         the rest of the process. Shapes revisited later hit the jit cache."""
         if not self._windows or any(g is not None for g in self.slots):
             return
+        if isinstance(self.cache, PagedKVCache):
+            if self.cache.page_table.shape[1] > self._first_slots:
+                # With no resident sessions every row is either already
+                # reset or will be reset at its next admission (stale ids
+                # are masked until then) — truncating columns is free and
+                # restores the narrow gather.
+                self.cache = self.cache.replace(
+                    page_table=self.cache.page_table[:, :self._first_slots]
+                )
+            return
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             return
         if self.cache.max_len > self._windows[0]:
@@ -492,6 +542,9 @@ class InferenceEngine:
                     ):
                         self._finish(s, "capacity", produced)
                         continue
+                    # Widen the page table first: the new slot index must
+                    # exist (a clamped update would corrupt another slot).
+                    self._ensure_capacity(len(s.pages) * self.ccfg.page_size + 1)
                     new = self.allocator.alloc(1)
                     self.cache = self.cache.assign_pages(
                         s.slot, new, start_slot=len(s.pages)

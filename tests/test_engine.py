@@ -357,3 +357,27 @@ def test_decode_windows_validation():
                          decode_windows=(-32, 64)),
             CacheConfig(kind="dense"),
         )
+
+
+def test_paged_table_growth_and_shrink():
+    eng = make_engine(kind="paged", batch=2)
+    first_slots = eng.cache.page_table.shape[1]
+    assert first_slots < eng.ccfg.max_pages_per_session
+    long_prompt = prompts(1, lo=30, hi=31, seed=60)[0]
+    ref_eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32", decode_windows=()),
+        CacheConfig(kind="paged", page_size=8, num_pages=64,
+                    max_pages_per_session=8),
+    )
+    ref = ref_eng.generate([long_prompt], SamplingOptions(max_new_tokens=12))
+    out = eng.generate([long_prompt], SamplingOptions(max_new_tokens=12))
+    assert out == ref
+    assert eng.metrics.snapshot().get("cache_growths", 0) >= 1
+    grown = eng.cache.page_table.shape[1]
+    assert grown > first_slots
+    # Idle admission shrinks the table back.
+    eng.generate([prompts(1, lo=3, hi=4, seed=61)[0]],
+                 SamplingOptions(max_new_tokens=2))
+    assert eng.cache.page_table.shape[1] < grown
