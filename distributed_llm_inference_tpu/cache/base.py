@@ -18,7 +18,41 @@ reads pages in place at decode).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
+
+
+def window_ladder(
+    cap: int,
+    custom: Optional[Sequence[int]] = None,
+    strict: bool = True,
+) -> Tuple[int, ...]:
+    """Buffer-size buckets for live-context growth: ~1.25x geometric,
+    32-aligned, ending exactly at ``cap``. ``custom`` overrides the ladder
+    ((), the empty ladder, disables growth); ``strict`` rejects a custom
+    ladder lying entirely above ``cap``, non-strict callers get ``(cap,)``.
+    Shared by the serving engine and the distributed block backend so the
+    bucket arithmetic cannot drift between them."""
+    if custom is not None:
+        if not custom:
+            return ()
+        if any(w <= 0 for w in custom):
+            raise ValueError(f"window buckets must be positive: {custom}")
+        ws = tuple(sorted(w for w in custom if w <= cap))
+        if not ws:
+            if strict:
+                raise ValueError(
+                    f"every window bucket exceeds the cache capacity "
+                    f"{cap}: {custom}"
+                )
+            return (cap,)
+        return ws if ws[-1] == cap else ws + (cap,)
+    ws, w = [], 32
+    while w < cap:
+        ws.append(w)
+        nxt = ((int(w * 1.25) + 31) // 32) * 32
+        w = nxt if nxt > w else w + 32
+    ws.append(cap)
+    return tuple(ws)
 
 
 class GatherAttendMixin:

@@ -128,6 +128,21 @@ class _DenseRowsMixin(GatherAttendMixin):
             sel, jnp.take_along_axis(new_vals, idx, axis=1), layer_buf
         )
 
+    def grow_to(self, new_len: int):
+        """Zero-pad every layer-stacked buffer's time axis (2) to
+        ``new_len`` — the growth-ladder step shared by the engine and the
+        block backend."""
+        pad = new_len - self.max_len
+        if pad <= 0:
+            return self
+
+        def grow(a):
+            widths = [(0, 0)] * a.ndim
+            widths[2] = (0, pad)
+            return jnp.pad(a, widths)
+
+        return self.with_layer_stacks(*(grow(a) for a in self.layer_stacks))
+
     def _mask(self, q, q_pos, num_new, sliding_window):
         t = self.max_len
         kv_pos = jnp.broadcast_to(

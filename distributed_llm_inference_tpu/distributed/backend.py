@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..cache.base import window_ladder
 from ..cache.dense import DenseKVCache
 from ..config import ModelConfig
 from ..models import llama
@@ -54,12 +55,19 @@ class BlockBackend:
         self.max_seq_len = max_seq_len
         self.dtype = jnp.dtype(dtype)
 
+        # Growth ladder (shared with the engine): the buffer starts at the
+        # smallest bucket and zero-pad-grows as resident sessions lengthen,
+        # so decode bandwidth tracks LIVE context; max_seq_len is the
+        # virtual cap.
+        self._windows = window_ladder(max_seq_len)
         self.cache = DenseKVCache.create(
-            self.num_block_layers, max_sessions, max_seq_len,
+            self.num_block_layers, max_sessions, self._windows[0],
             cfg.num_kv_heads, cfg.head_dim, dtype,
         )
         # generation_id → (slot row, last-touch time); free slots LRU-reused.
         self.sessions: Dict[str, Tuple[int, float]] = {}
+        # Host-side per-slot lengths (avoids a device sync per hop).
+        self._slot_len: Dict[int, int] = {}
 
         def _row_step(params, x, cache, row, n_valid):
             sub = cache.select_row(row)
@@ -113,7 +121,14 @@ class BlockBackend:
                 )
             lru = min(idle, key=lambda g: self.sessions[g][1])
             slot = self.sessions.pop(lru)[0]
+        if not self.sessions and self.cache.max_len > self._windows[0]:
+            # Nothing resident: drop back to the smallest bucket (no copy).
+            self.cache = DenseKVCache.create(
+                self.num_block_layers, self.max_sessions, self._windows[0],
+                self.cfg.num_kv_heads, self.cfg.head_dim, self.dtype,
+            )
         self.sessions[generation_id] = (slot, time.monotonic())
+        self._slot_len[slot] = 0
         self.cache = self.cache.reset_rows(
             np.arange(self.max_sessions) == slot
         )
@@ -148,8 +163,18 @@ class BlockBackend:
         xa = np.asarray(x)
         self.validate(xa, num_new)
         slot = self._slot_for(generation_id, create=create)
+        needed = self._slot_len.get(slot, 0) + num_new
+        if needed > self.max_seq_len:
+            raise SchemaError(
+                f"session exceeds max_seq_len={self.max_seq_len}"
+            )
+        if needed > self.cache.max_len:
+            self.cache = self.cache.grow_to(
+                next(w for w in self._windows if w >= needed)
+            )
         y, self.cache = self._row_step(
             self.params, jnp.asarray(xa, self.dtype), self.cache,
             jnp.int32(slot), jnp.int32(num_new),
         )
+        self._slot_len[slot] = needed
         return np.asarray(jax.device_get(y))

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..cache.base import window_ladder
 from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
 from ..cache.paged import PageAllocator, PagedKVCache
 from ..cache.sink import SinkKVCache
@@ -218,38 +219,12 @@ class InferenceEngine:
     def _window_ladder(
         self, cap: Optional[int] = None, strict: bool = True
     ) -> Tuple[int, ...]:
-        """Buffer-size buckets: ~1.25x geometric, 32-aligned, capped at
-        ``cap`` (default max_seq_len). () disables growth (fixed buffers).
-        ``strict`` rejects a custom ladder that lies entirely above ``cap``;
-        non-strict callers just get ``(cap,)``."""
-        cap = cap if cap is not None else self.ecfg.max_seq_len
-        if self.ecfg.decode_windows is not None:
-            if not self.ecfg.decode_windows:
-                return ()  # explicit opt-out: fixed max-size buffer
-            if any(w <= 0 for w in self.ecfg.decode_windows):
-                raise ValueError(
-                    f"decode_windows must be positive: {self.ecfg.decode_windows}"
-                )
-            ws = tuple(sorted(
-                w for w in self.ecfg.decode_windows if w <= cap
-            ))
-            if not ws:
-                if strict:
-                    raise ValueError(
-                        f"every decode_windows entry exceeds the cache "
-                        f"capacity {cap}: {self.ecfg.decode_windows}"
-                    )
-                return (cap,)
-            if ws[-1] != cap:
-                ws = ws + (cap,)
-            return ws
-        ws, w = [], 32
-        while w < cap:
-            ws.append(w)
-            nxt = ((int(w * 1.25) + 31) // 32) * 32
-            w = nxt if nxt > w else w + 32
-        ws.append(cap)
-        return tuple(ws)
+        """See :func:`cache.base.window_ladder`; ``decode_windows`` is the
+        custom override."""
+        return window_ladder(
+            cap if cap is not None else self.ecfg.max_seq_len,
+            custom=self.ecfg.decode_windows, strict=strict,
+        )
 
     def _ensure_capacity(self, needed_len: int) -> None:
         """Grow the cache's attended span to the smallest bucket covering
@@ -277,20 +252,11 @@ class InferenceEngine:
             return
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             return
-        t = self.cache.max_len
         new_t = next(
             (w for w in self._windows if w >= needed_len),
             self.ecfg.max_seq_len,
         )
-        pad = new_t - t
-
-        def grow(a):  # time axis is 2 on every layer-stacked buffer
-            widths = [(0, 0)] * a.ndim
-            widths[2] = (0, pad)
-            return jnp.pad(a, widths)
-
-        stacks = tuple(grow(a) for a in self.cache.layer_stacks)
-        self.cache = self.cache.with_layer_stacks(*stacks)
+        self.cache = self.cache.grow_to(new_t)
         self.metrics.counter("cache_growths")
 
     def _with_mesh(self, fn):

@@ -274,3 +274,30 @@ def test_prompt_longer_than_bucket_chunked_prefill(cluster, params):
     ) as client:
         got = client.generate(prompt, max_new_tokens=4)
     assert got == _oracle_greedy(params, prompt, 4)
+
+
+def test_backend_buffer_growth(params):
+    from distributed_llm_inference_tpu.distributed.backend import BlockBackend
+
+    b = BlockBackend(CFG, {k: v[0:2] for k, v in params["layers"].items()},
+                     0, 1, max_sessions=2, max_seq_len=128, dtype=jnp.float32)
+    first = b.cache.max_len
+    assert first < 128
+    x = np.zeros((1, 48, CFG.hidden_size), np.float32)
+    b.forward("g1", x, 48, create=True)
+    assert b.cache.max_len >= 48
+    grown = b.cache.max_len
+    for i in range(4):
+        b.forward("g1", x[:, :1], 1)
+    # Exceeding the virtual cap fails loudly.
+    b.forward("g2", np.zeros((1, 64, CFG.hidden_size), np.float32), 64,
+              create=True)
+    from distributed_llm_inference_tpu.distributed.backend import SchemaError
+    with pytest.raises(SchemaError, match="max_seq_len"):
+        for _ in range(80):
+            b.forward("g2", x[:, :1], 1)
+    # All sessions gone -> next admission shrinks back.
+    b.end("g1"); b.end("g2")
+    b.forward("g3", x[:, :1], 1, create=True)
+    assert b.cache.max_len <= grown
+    assert b.cache.max_len == b._windows[0]
