@@ -7,7 +7,7 @@ The TPU-native realization of the reference's multi-tenancy goal: its
 which cannot live under ``jit``. Here the per-``generation_id`` state becomes
 integer indexing into a preallocated page pool (PagedAttention-style): sessions
 own rows of a ``page_table``; pages are allocated/freed host-side by the
-scheduler (``engine/scheduler.py``) and the device computation only ever sees
+scheduler (``engine/engine.py``) and the device computation only ever sees
 static shapes.
 
 Layout:
