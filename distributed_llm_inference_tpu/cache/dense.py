@@ -36,6 +36,33 @@ from ..ops.rotary import RopeAngles, apply_rope
 from .base import GatherAttendMixin
 
 
+def _tail_flush_rows(big, tail, lengths, tail_len, axis):
+    """Merge a write-behind tail into the big buffer at per-row offsets.
+
+    ``big``/``tail``: ``[L, B, …]`` with the time axis (length ``T`` / ``K``)
+    at per-row axis ``axis`` (coordinates of the ``[L, …]`` row view; the
+    full-array axis is ``axis + 1``, batch being axis 1). One vectorized
+    full-buffer gather+select — two passes over the cache, amortized over
+    the K fused steps. (Alternatives measured worse: per-row
+    slice/merge/write-back crashes the compiler at 7B shapes; a
+    ``lax.map``-layer-chunked merge is ~20% slower end-to-end.)
+    """
+    kk = tail.shape[axis + 1]
+    b = big.shape[1]
+    t = big.shape[axis + 1]
+    nd = big.ndim
+    src = jnp.arange(t, dtype=jnp.int32)[None, :] - lengths[:, None]  # [B, T]
+    sel = (src >= 0) & (src < tail_len[:, None])
+    shp = [1] * nd
+    shp[1] = b
+    shp[axis + 1] = t
+    idx = jnp.clip(src, 0, kk - 1).reshape(shp)
+    selb = sel.reshape(shp)
+    return jnp.where(
+        selb, jnp.take_along_axis(tail, idx, axis=axis + 1), big
+    )
+
+
 class _DenseRowsMixin(GatherAttendMixin):
     """Shared row bookkeeping for contiguous per-row caches: absolute
     positions from ``lengths``, bucket-safe writes, causal masking, and
@@ -104,12 +131,27 @@ class _DenseRowsMixin(GatherAttendMixin):
             # Decode hot path: single-token contiguous write. Always in
             # bounds — the scheduler's capacity check guarantees
             # ``lengths + 1 <= max_len`` for active rows — and it partitions
-            # cleanly under SPMD (a scatter here trips XLA's partitioner).
-            def write_row(buf, val, start):
+            # cleanly under SPMD (a scatter here ABORTS in GSPMD inside the
+            # shard_map pipeline; and the per-row traced offsets make this
+            # vmap lower to a serial while over rows on TPU, ~26ms/step at
+            # batch 80 7B shapes — the write-behind decode path in
+            # ``llama.multi_decode_apply`` exists to keep this off the hot
+            # loop).
+            # Inactive rows (num_new == 0) must write NOTHING: their offset
+            # may sit at a full buffer's end, where the DUS clamp would
+            # overwrite the row's last real token (an idle co-batched
+            # session would silently corrupt). Re-writing the old value
+            # keeps the write unconditional but harmless.
+            def write_row(buf, val, start, n):
                 start_idx = (start,) + (0,) * (buf.ndim - 1)
-                return jax.lax.dynamic_update_slice(buf, val, start_idx)
+                old = jax.lax.dynamic_slice(buf, start_idx, val.shape)
+                return jax.lax.dynamic_update_slice(
+                    buf, jnp.where(n > 0, val, old), start_idx
+                )
 
-            return jax.vmap(write_row)(layer_buf, new_vals, self.lengths)
+            return jax.vmap(write_row)(
+                layer_buf, new_vals, self.lengths, num_new
+            )
         # Prefill: the chunk is padded to a bucket that may extend past
         # the buffer end (bucket > remaining capacity), where a contiguous
         # dynamic_update_slice would either fail to compile (update wider
@@ -150,6 +192,26 @@ class _DenseRowsMixin(GatherAttendMixin):
         )
         kv_valid = kv_pos < (self.lengths + num_new)[:, None]
         return causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
+
+    def _segment_valids(self, base_len, tail_len, num_new, t, kk,
+                        sliding_window):
+        """Validity masks ``([B, T], [B, K])`` for the (big, tail) segments
+        of the fused decode — shared by the bf16 and int8 ``tail_attend``
+        so the window/validity rules cannot diverge."""
+        q_pos = base_len + tail_len
+        big_pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+        big_valid = big_pos < base_len[:, None]
+        tail_pos = (
+            base_len[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+        )
+        tail_valid = (
+            jnp.arange(kk, dtype=jnp.int32)[None, :]
+            < (tail_len + num_new)[:, None]
+        )
+        if sliding_window is not None:
+            big_valid &= big_pos > (q_pos[:, None] - sliding_window)
+            tail_valid &= tail_pos > (q_pos[:, None] - sliding_window)
+        return big_valid, tail_valid
 
 
 class DenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
@@ -218,6 +280,49 @@ class DenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         mask = self._mask(q, q_pos, num_new, sliding_window)
         return q_rot, new_k, new_v, mask, (new_k, new_v)
 
+    # -- write-behind tail (fused multi-step decode) --------------------------
+
+    def tail_init(self, k_steps: int):
+        l, b, t, h, d = self.k.shape
+        z = jnp.zeros((l, b, k_steps, h, d), self.k.dtype)
+        return (z, z)
+
+    def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
+                    base_len, tail_len, step_idx, num_new, sliding_window,
+                    scale=None):
+        """Two-segment attention: the big buffer stays read-only; the new
+        token's k/v lands in the tail at scalar slot ``step_idx`` (one
+        vectorized write — see ``multi_decode_apply``)."""
+        from ..ops.attention import gqa_attention_segments
+
+        big_k, big_v = big_state
+        tk, tv = tail_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        tk = jax.lax.dynamic_update_slice_in_dim(tk, k_rot, step_idx, axis=1)
+        tv = jax.lax.dynamic_update_slice_in_dim(tv, v_new, step_idx, axis=1)
+
+        big_valid, tail_valid = self._segment_valids(
+            base_len, tail_len, num_new, big_k.shape[1], tk.shape[1],
+            sliding_window,
+        )
+        out = gqa_attention_segments(
+            q_rot,
+            [(big_k, big_v, big_valid), (tk, tv, tail_valid)],
+            scale,
+        )
+        return out, (tk, tv)
+
+    def tail_flush(self, tail, tail_len):
+        """Merge the tail into the big buffers (per-row K-token windows,
+        amortized over the K fused steps) and advance lengths."""
+        wk, wv = tail  # [L, B, K, Hkv, D]
+        return self.replace(
+            k=_tail_flush_rows(self.k, wk, self.lengths, tail_len, axis=1),
+            v=_tail_flush_rows(self.v, wv, self.lengths, tail_len, axis=1),
+            lengths=self.lengths + tail_len,
+        )
+
 
 def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-(token, head) symmetric int8: ``x`` ``[B, S, H, D]`` →
@@ -233,12 +338,16 @@ def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
     """Dense cache with int8 K/V + per-(token, head) fp32 scales.
 
-    ``k``/``v``: int8 ``[L, B, T, Hkv, D]``; ``ks``/``vs``: f32
-    ``[L, B, T, Hkv]`` (≈3% byte overhead at D=128). The reference's cache is
-    unquantized fp16 torch tensors (``models/llama/cache.py``); int8 KV is
-    the TPU-native bandwidth play for the decode path, analogous to its
-    bitsandbytes int8 *weights* (``utils/model.py:93-123``) applied to the
-    cache instead.
+    ``k``/``v``: int8 ``[L, B, Hkv, T, D]``; ``ks``/``vs``: f32
+    ``[L, B, Hkv, T]`` (≈3% byte overhead at D=128). The layout is
+    HEAD-major (time axis 3, unlike the bf16 cache's ``[L, B, T, Hkv, D]``):
+    the attention contractions then consume the int8 buffers directly with no
+    transpose, which is what lets XLA keep the int8→bf16 convert inside the
+    dot instead of materializing a bf16 copy of K and V every decode step.
+    The reference's cache is unquantized fp16 torch tensors
+    (``models/llama/cache.py``); int8 KV is the TPU-native bandwidth play for
+    the decode path, analogous to its bitsandbytes int8 *weights*
+    (``utils/model.py:93-123``) applied to the cache instead.
     """
 
     k: jax.Array
@@ -246,6 +355,9 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
     ks: jax.Array
     vs: jax.Array
     lengths: jax.Array
+    # Decode via the Pallas kernel (ops/quant_attention.py): int8 K/V stream
+    # through VMEM once instead of XLA materializing bf16 copies each step.
+    use_kernel: bool = struct.field(pytree_node=False, default=False)
 
     BATCH_AXES = {"k": 1, "v": 1, "ks": 1, "vs": 1, "lengths": 0}
     LAYER_FIELDS = ("k", "v", "ks", "vs")
@@ -258,8 +370,9 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         num_kv_heads: int,
         head_dim: int,
         dtype=jnp.bfloat16,  # accepted for interface parity; values are int8
+        use_kernel: bool = False,
     ) -> "QuantizedDenseKVCache":
-        shape = (num_layers, batch, max_seq_len, num_kv_heads, head_dim)
+        shape = (num_layers, batch, num_kv_heads, max_seq_len, head_dim)
         sshape = shape[:-1]
         return QuantizedDenseKVCache(
             k=jnp.zeros(shape, jnp.int8),
@@ -267,11 +380,12 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
             ks=jnp.zeros(sshape, jnp.float32),
             vs=jnp.zeros(sshape, jnp.float32),
             lengths=jnp.zeros((batch,), jnp.int32),
+            use_kernel=use_kernel,
         )
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     @property
     def layer_stacks(self):
@@ -279,6 +393,100 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
 
     def with_layer_stacks(self, k, v, ks, vs) -> "QuantizedDenseKVCache":
         return self.replace(k=k, v=v, ks=ks, vs=vs)
+
+    def _write(self, layer_buf, new_vals, num_new):
+        """Head-major write: incoming ``[B, S, Hkv(, D)]`` rows merged into
+        ``[B, Hkv, T(, D)]`` at each row's offset (cf. the time-major mixin
+        version, whose regimes this mirrors)."""
+        b, s = new_vals.shape[:2]
+        t = layer_buf.shape[2]
+        nv = jnp.moveaxis(new_vals, 1, 2)  # [B, Hkv, S(, D)]
+        if s == 1:
+            # Per-row DUS (see the time-major mixin's notes: scatter aborts
+            # under GSPMD; inactive rows re-write the old value so a clamped
+            # offset cannot corrupt; the fused multi-step decode keeps this
+            # write off the hot path).
+            def write_row(buf, val, start, n):
+                start_idx = (0, start) + (0,) * (buf.ndim - 2)
+                old = jax.lax.dynamic_slice(buf, start_idx, val.shape)
+                return jax.lax.dynamic_update_slice(
+                    buf, jnp.where(n > 0, val, old), start_idx
+                )
+
+            return jax.vmap(write_row)(layer_buf, nv, self.lengths, num_new)
+        src = (
+            jnp.arange(t, dtype=jnp.int32)[None, :] - self.lengths[:, None]
+        )  # [B, T]
+        take = (src >= 0) & (src < num_new[:, None])
+        extra = nv.ndim - 3  # 1 for k/v (trailing D), 0 for scale planes
+        idx = jnp.clip(src, 0, s - 1).reshape(b, 1, t, *([1] * extra))
+        sel = take.reshape(b, 1, t, *([1] * extra))
+        return jnp.where(
+            sel, jnp.take_along_axis(nv, idx, axis=2), layer_buf
+        )
+
+    def grow_to(self, new_len: int):
+        """Zero-pad the time axis — axis 3 for values AND scale planes in
+        the head-major layout."""
+        pad = new_len - self.max_len
+        if pad <= 0:
+            return self
+
+        def grow(a):
+            widths = [(0, 0)] * a.ndim
+            widths[3] = (0, pad)
+            return jnp.pad(a, widths)
+
+        return self.with_layer_stacks(*(grow(a) for a in self.layer_stacks))
+
+    def attend(
+        self,
+        layer_state,
+        q,
+        k_new,
+        v_new,
+        rope,
+        q_pos,
+        num_new,
+        sliding_window,
+        attention_fn,
+        scale=None,
+    ):
+        """Quantized fast path: int8 K/V feed the attention matmuls directly,
+        per-(token, head) scales applied to the scores (see
+        :func:`ops.attention.gqa_attention_quantized` — the dequant-multiply
+        formulation materializes bf16 K/V copies each step). A non-default
+        ``attention_fn`` (Pallas kernels expect bf16 K/V) falls back to the
+        dequantizing gather path."""
+        from ..ops.attention import gqa_attention, gqa_attention_quantized
+
+        if attention_fn is not gqa_attention:
+            return super().attend(
+                layer_state, q, k_new, v_new, rope, q_pos, num_new,
+                sliding_window, attention_fn, scale,
+            )
+        layer_k, layer_v, layer_ks, layer_vs = layer_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        k_q, k_s = _quantize_kv(k_rot)
+        v_q, v_s = _quantize_kv(v_new)
+        new_k = self._write(layer_k, k_q, num_new)
+        new_v = self._write(layer_v, v_q, num_new)
+        new_ks = self._write(layer_ks, k_s, num_new)
+        new_vs = self._write(layer_vs, v_s, num_new)
+        if self.use_kernel and q.shape[1] == 1:
+            from ..ops.quant_attention import quantized_decode_attention
+
+            out = quantized_decode_attention(
+                q_rot, new_k, new_ks, new_v, new_vs,
+                self.lengths + num_new, scale, sliding_window,
+            )
+        else:
+            mask = self._mask(q, q_pos, num_new, sliding_window)
+            out = gqa_attention_quantized(
+                q_rot, new_k, new_ks, new_v, new_vs, mask, scale
+            )
+        return out, (new_k, new_v, new_ks, new_vs)
 
     def update_and_gather(
         self,
@@ -292,8 +500,9 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         sliding_window: Optional[int] = None,
     ) -> Tuple[jnp.ndarray, ...]:
         """As :meth:`DenseKVCache.update_and_gather`, but values are stored
-        int8 and returned DEQUANTIZED (a broadcast multiply XLA fuses into
-        the attention operand read — no materialized bf16 copy)."""
+        int8 and returned DEQUANTIZED and transposed back to time-major
+        ``[B, T, Hkv, D]`` (the fallback path for non-default attention fns;
+        the default path is :meth:`attend` above)."""
         layer_k, layer_v, layer_ks, layer_vs = layer_state
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
@@ -306,7 +515,73 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         new_vs = self._write(layer_vs, v_s, num_new)
 
         dt = q.dtype
-        k_all = new_k.astype(dt) * new_ks[..., None].astype(dt)
-        v_all = new_v.astype(dt) * new_vs[..., None].astype(dt)
+        k_all = (new_k.astype(dt) * new_ks[..., None].astype(dt)).transpose(
+            0, 2, 1, 3
+        )
+        v_all = (new_v.astype(dt) * new_vs[..., None].astype(dt)).transpose(
+            0, 2, 1, 3
+        )
         mask = self._mask(q, q_pos, num_new, sliding_window)
         return q_rot, k_all, v_all, mask, (new_k, new_v, new_ks, new_vs)
+
+    # -- write-behind tail (fused multi-step decode) --------------------------
+
+    def tail_init(self, k_steps: int):
+        l, b, h, t, d = self.k.shape
+        zq = jnp.zeros((l, b, h, k_steps, d), jnp.int8)
+        zs = jnp.zeros((l, b, h, k_steps), jnp.float32)
+        return (zq, zq, zs, zs)
+
+    def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
+                    base_len, tail_len, step_idx, num_new, sliding_window,
+                    scale=None):
+        """Two-segment int8 attention; the big head-major buffer is
+        read-only, the new token is quantized into the tail at scalar slot
+        ``step_idx``."""
+        from ..ops.attention import gqa_attention_quantized_segments
+
+        big_k, big_v, big_ks, big_vs = big_state
+        tk, tv, tks, tvs = tail_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        k_q, k_s = _quantize_kv(k_rot)   # [B, 1, Hkv, D] / [B, 1, Hkv]
+        v_q, v_s = _quantize_kv(v_new)
+        tk = jax.lax.dynamic_update_slice_in_dim(
+            tk, jnp.moveaxis(k_q, 1, 2), step_idx, axis=2
+        )
+        tv = jax.lax.dynamic_update_slice_in_dim(
+            tv, jnp.moveaxis(v_q, 1, 2), step_idx, axis=2
+        )
+        tks = jax.lax.dynamic_update_slice_in_dim(
+            tks, jnp.moveaxis(k_s, 1, 2), step_idx, axis=2
+        )
+        tvs = jax.lax.dynamic_update_slice_in_dim(
+            tvs, jnp.moveaxis(v_s, 1, 2), step_idx, axis=2
+        )
+
+        big_valid, tail_valid = self._segment_valids(
+            base_len, tail_len, num_new, big_k.shape[2], tk.shape[2],
+            sliding_window,
+        )
+        out = gqa_attention_quantized_segments(
+            q_rot,
+            [
+                (big_k, big_ks, big_v, big_vs, big_valid),
+                (tk, tks, tv, tvs, tail_valid),
+            ],
+            scale,
+        )
+        return out, (tk, tv, tks, tvs)
+
+    def tail_flush(self, tail, tail_len):
+        """Per-row K-token window merge (head-major: time axis 2 of the
+        ``[L, Hkv, T(, D)]`` row view)."""
+        wk, wv, wks, wvs = tail  # [L, B, Hkv, K, D] / [L, B, Hkv, K]
+        merge = lambda big, tl: _tail_flush_rows(
+            big, tl, self.lengths, tail_len, axis=2
+        )
+        return self.replace(
+            k=merge(self.k, wk), v=merge(self.v, wv),
+            ks=merge(self.ks, wks), vs=merge(self.vs, wvs),
+            lengths=self.lengths + tail_len,
+        )

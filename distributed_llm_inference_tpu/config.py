@@ -178,5 +178,11 @@ class EngineConfig:
     # early in long-context serving). None = auto ladder; () disables.
     decode_windows: Optional[Tuple[int, ...]] = None
     use_pallas_attention: bool = False
+    # Tokens decoded per device dispatch (lax.scan over the decode step with
+    # sampling, EOS and per-row token budgets all in-graph). Each host→device
+    # round trip costs ~50 ms through the tunnel at 7B shapes — far more than
+    # the step's HBM traffic — so K-step decode multiplies throughput.
+    # Tradeoff: tokens stream to consumers every K steps, not every step.
+    decode_steps: int = 1
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled

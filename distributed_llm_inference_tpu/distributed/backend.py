@@ -77,6 +77,19 @@ class BlockBackend:
 
         self._row_step = jax.jit(_row_step, donate_argnums=(2,))
 
+        # Batched step over ALL session rows at once (rows with num_new=0 are
+        # masked): N concurrent hops become one device call. Single hops keep
+        # the row step — it reads only that row's cache, while this one reads
+        # every row's.
+        def _batch_step(params, x, cache, num_new):
+            y, cache = llama.block_apply(self.cfg, params, x, cache, num_new)
+            return y, cache.advance(num_new)
+
+        self._batch_step = jax.jit(_batch_step, donate_argnums=(2,))
+        # Observability (tests assert batching actually happens).
+        self.batched_calls = 0
+        self.batched_items = 0
+
         # Output schema inferred by a dummy forward (the reference's
         # ``backend.py:31-35`` pattern): hidden-in → hidden-out, same shape.
         probe = jnp.zeros((1, 1, cfg.hidden_size), dtype)
@@ -160,21 +173,80 @@ class BlockBackend:
         bucket), ``num_new`` = valid token count. ``create`` admits a new
         session (the prefill hop); decode hops require the session to exist.
         Returns ``[1, S, H]``."""
-        xa = np.asarray(x)
-        self.validate(xa, num_new)
-        slot = self._slot_for(generation_id, create=create)
-        needed = self._slot_len.get(slot, 0) + num_new
-        if needed > self.max_seq_len:
-            raise SchemaError(
-                f"session exceeds max_seq_len={self.max_seq_len}"
-            )
-        if needed > self.cache.max_len:
-            self.cache = self.cache.grow_to(
-                next(w for w in self._windows if w >= needed)
-            )
-        y, self.cache = self._row_step(
-            self.params, jnp.asarray(xa, self.dtype), self.cache,
-            jnp.int32(slot), jnp.int32(num_new),
-        )
-        self._slot_len[slot] = needed
-        return np.asarray(jax.device_get(y))
+        result = self.forward_many([(generation_id, x, num_new, create)])[0]
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def forward_many(self, items) -> List:
+        """Run N forward hops in ONE device call — the batching role the
+        reference delegated to hivemind's ``TaskPool``
+        (``/root/reference/distributed_llm_inference/server/backend.py:42``).
+
+        ``items``: ``[(generation_id, x, num_new, create), …]`` with equal
+        padded ``S`` (the task pool's signature guarantees this). Returns one
+        result per item, positionally; a failed item carries its exception so
+        one bad request cannot fail the co-batched ones.
+        """
+        results: List = [None] * len(items)
+        resolved = []  # (item idx, slot, x, num_new, new total length)
+        taken = set()
+        deferred = []  # same-slot duplicates: run in a follow-up call
+        for i, (gid, x, num_new, create) in enumerate(items):
+            try:
+                xa = np.asarray(x)
+                self.validate(xa, num_new)
+                slot = self._slot_for(gid, create=create)
+                if slot in taken:
+                    deferred.append(i)
+                    continue
+                needed = self._slot_len.get(slot, 0) + num_new
+                if needed > self.max_seq_len:
+                    raise SchemaError(
+                        f"session exceeds max_seq_len={self.max_seq_len}"
+                    )
+                taken.add(slot)
+                resolved.append((i, slot, xa, num_new, needed))
+            except Exception as e:
+                results[i] = e
+
+        if resolved:
+            need_max = max(n for *_, n in resolved)
+            if need_max > self.cache.max_len:
+                self.cache = self.cache.grow_to(
+                    next(w for w in self._windows if w >= need_max)
+                )
+            if len(resolved) == 1:
+                i, slot, xa, num_new, needed = resolved[0]
+                y, self.cache = self._row_step(
+                    self.params, jnp.asarray(xa, self.dtype), self.cache,
+                    jnp.int32(slot), jnp.int32(num_new),
+                )
+                results[i] = np.asarray(jax.device_get(y))
+                self._slot_len[slot] = needed
+            else:
+                s = resolved[0][2].shape[1]
+                xb = np.zeros(
+                    (self.max_sessions, s, self.cfg.hidden_size), np.float32
+                )
+                nn = np.zeros((self.max_sessions,), np.int32)
+                for i, slot, xa, num_new, _ in resolved:
+                    xb[slot] = xa[0]
+                    nn[slot] = num_new
+                y, self.cache = self._batch_step(
+                    self.params, jnp.asarray(xb, self.dtype), self.cache,
+                    jnp.asarray(nn),
+                )
+                yh = np.asarray(jax.device_get(y))
+                self.batched_calls += 1
+                self.batched_items += len(resolved)
+                for i, slot, _, _, needed in resolved:
+                    results[i] = yh[slot : slot + 1]
+                    self._slot_len[slot] = needed
+
+        if deferred:
+            for i, r in zip(
+                deferred, self.forward_many([items[i] for i in deferred])
+            ):
+                results[i] = r
+        return results

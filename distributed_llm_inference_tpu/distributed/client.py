@@ -106,9 +106,15 @@ class DistributedClient:
                                                        timeout=timeout))
         if reply_header.get("op") == "error":
             msg = f"worker {reply_header.get('from')}: {reply_header['error']}"
-            raise WorkerError(
-                msg, retryable="unknown generation" in reply_header["error"]
+            # Retryability keys on the machine-readable code (worker.py:
+            # error_code); the message-text fallback covers frames from
+            # older workers that predate the code field.
+            code = reply_header.get("code")
+            retryable = (
+                code == "unknown_generation" if code is not None
+                else "unknown generation" in reply_header["error"]
             )
+            raise WorkerError(msg, retryable=retryable)
         if reply_header.get("gen_id") != gen_id:
             raise RuntimeError("out-of-order reply (concurrent use of one "
                                "client instance is not supported)")

@@ -31,8 +31,22 @@ from .backend import BlockBackend, SchemaError
 from .directory import DirectoryClient
 from .messages import pack_frame, unpack_frame
 from .relay import RelayClient
+from .task_pool import TaskPool
 
-__all__ = ["ServingNode"]
+__all__ = ["ServingNode", "error_code"]
+
+
+def error_code(e: Exception) -> str:
+    """Machine-readable error classification for error frames. Clients key
+    retry/failover decisions on this, never on message text (a reworded
+    message must not silently disable replay)."""
+    if isinstance(e, KeyError):
+        return "unknown_generation"
+    if isinstance(e, SchemaError):
+        return "schema"
+    if isinstance(e, RuntimeError) and "node full" in str(e):
+        return "node_full"
+    return "internal"
 
 
 class ServingNode:
@@ -50,6 +64,7 @@ class ServingNode:
         heartbeat_s: float = 2.0,
         lease_ttl: float = 10.0,
         dtype=None,
+        batch_window_s: float = 0.002,
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.queue = f"block.{self.node_id}"
@@ -64,10 +79,33 @@ class ServingNode:
         self.errors: List[str] = []
         self.restarts = 0
 
+        # Register FIRST: a directory/relay failure here must not leak the
+        # pool thread or relay sockets (there is no node object to stop()).
         self._directory = DirectoryClient(relay_port, host)
-        self._directory.register(
-            self.node_id, first_layer, last_layer, self.queue, ttl=lease_ttl
-        )
+        try:
+            self._directory.register(
+                self.node_id, first_layer, last_layer, self.queue,
+                ttl=lease_ttl,
+            )
+            # All backend work flows through the task pool (one thread): N
+            # concurrent sessions' compatible hops (same op + padded length)
+            # group into ONE batched device call instead of N serial ones,
+            # and backend state needs no locking. Replies are sent from the
+            # pool thread over its own relay connection.
+            self._out = RelayClient(host, relay_port)
+        except Exception:
+            self._directory.close()
+            raise
+        try:
+            self._pool = TaskPool(
+                self._process_batch, max_batch=max_sessions,
+                window_s=batch_window_s, signature=lambda item: item[0],
+                name=f"{self.node_id}.pool",
+            )
+        except Exception:
+            self._out.close()
+            self._directory.close()
+            raise
         self._consume_thread = self._spawn_consumer()
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True
@@ -84,7 +122,6 @@ class ServingNode:
 
     def _consume(self) -> None:
         client = RelayClient(self.host, self.relay_port)
-        out = RelayClient(self.host, self.relay_port)
         try:
             while not self._stop.is_set():
                 try:
@@ -96,29 +133,21 @@ class ServingNode:
                 if op == "shutdown":
                     return
                 if op == "end":
-                    self.backend.end(header.get("gen_id", ""))
+                    # Through the pool so backend state stays single-threaded.
+                    self._pool.submit((("end",), header, None))
                     continue
                 if op != "forward":
                     continue
-                hops = header.get("hops") or []
-                try:
-                    if not hops:
-                        raise SchemaError("forward frame without hops")
-                    y = self.backend.forward(
-                        header["gen_id"], arr, header["num_new"],
-                        create=bool(header.get("new", False)),
-                    )
-                    reply = {**header, "hops": hops[1:], "from": self.node_id}
-                    out.put(hops[0], pack_frame(reply, y))
-                except (SchemaError, KeyError, RuntimeError) as e:
-                    # Protocol/session errors go back to the client's reply
-                    # queue (last hop) so generate() fails fast instead of
-                    # hanging; a hops-less frame has nowhere to report to.
-                    if hops:
-                        err = {"op": "error", "gen_id": header.get("gen_id"),
-                               "error": f"{type(e).__name__}: {e}",
-                               "from": self.node_id}
-                        out.put(hops[-1], pack_frame(err))
+                if not header.get("hops"):
+                    continue  # nowhere to reply or report to — drop
+                # Group key: hops of equal padded length batch together
+                # (decode steps with decode steps, like-bucketed prefills
+                # with each other). Malformed payloads (missing / wrong-rank
+                # tensor) get a degenerate key and fail per-item in
+                # backend.validate → error reply, never the consume loop.
+                shape = getattr(arr, "shape", ())
+                s_key = shape[1] if len(shape) >= 2 else -1
+                self._pool.submit((("fwd", s_key), header, arr))
         except (ConnectionError, OSError):
             return  # relay gone: health loop will notice / tests tear down
         except Exception:
@@ -128,7 +157,41 @@ class ServingNode:
             raise
         finally:
             client.close()
-            out.close()
+
+    def _process_batch(self, items) -> List[None]:
+        """Task-pool fn: one batch of same-signature frames → one backend
+        call; replies/errors go straight back over the relay (futures are
+        fire-and-forget)."""
+        try:
+            if items[0][0] == ("end",):
+                for _, header, _ in items:
+                    self.backend.end(header.get("gen_id", ""))
+                return [None] * len(items)
+            reqs = [
+                (h.get("gen_id", ""), arr, h.get("num_new", 0),
+                 bool(h.get("new", False)))
+                for _, h, arr in items
+            ]
+            outs = self.backend.forward_many(reqs)
+            for (_, header, _), y in zip(items, outs):
+                hops = header.get("hops") or []
+                if isinstance(y, Exception):
+                    # Protocol/session errors go back to the client's reply
+                    # queue (last hop) so generate() fails fast instead of
+                    # hanging.
+                    err = {"op": "error", "gen_id": header.get("gen_id"),
+                           "error": f"{type(y).__name__}: {y}",
+                           "code": error_code(y), "from": self.node_id}
+                    self._out.put(hops[-1], pack_frame(err))
+                else:
+                    reply = {**header, "hops": hops[1:], "from": self.node_id}
+                    self._out.put(hops[0], pack_frame(reply, y))
+            return [None] * len(items)
+        except (ConnectionError, OSError):
+            return [None] * len(items)  # relay gone mid-reply: teardown
+        except Exception:
+            self.errors.append(traceback.format_exc())
+            raise
 
     # -- health / leases ------------------------------------------------------
 
@@ -172,6 +235,8 @@ class ServingNode:
         self._directory.close()
         self._consume_thread.join(timeout=5)
         self._health_thread.join(timeout=5)
+        self._pool.stop()
+        self._out.close()
 
     def __enter__(self):
         return self

@@ -107,6 +107,14 @@ class InferenceEngine:
             cache_cls = (
                 QuantizedDenseKVCache if cc.kv_quant == "int8" else DenseKVCache
             )
+            # For the int8 cache, use_pallas_attention selects its OWN decode
+            # kernel (ops/quant_attention.py — streams int8 through VMEM);
+            # the flash kernel below expects bf16 K/V and would force the
+            # dequantizing fallback.
+            create_kw = (
+                {"use_kernel": self.ecfg.use_pallas_attention}
+                if cc.kv_quant == "int8" else {}
+            )
             # Start at the smallest bucket; _ensure_capacity grows the buffer
             # (one pad-copy per growth) as sequences lengthen. Decode
             # bandwidth tracks the LIVE context, not max_seq_len: a padded
@@ -117,7 +125,7 @@ class InferenceEngine:
             first = self._windows[0] if self._windows else self.ecfg.max_seq_len
             self.cache = cache_cls.create(
                 cfg.num_layers, b, first, cfg.num_kv_heads,
-                cfg.head_dim, dtype,
+                cfg.head_dim, dtype, **create_kw,
             )
             self.allocator = None
         elif cc.kind == "paged":
@@ -179,7 +187,11 @@ class InferenceEngine:
 
 
         attention = attention_fn
-        if attention is None and self.ecfg.use_pallas_attention:
+        if (
+            attention is None
+            and self.ecfg.use_pallas_attention
+            and not isinstance(self.cache, QuantizedDenseKVCache)
+        ):
             from ..ops.flash_attention import flash_attention
 
             attention = flash_attention  # falls back to XLA on decode shapes
@@ -210,11 +222,54 @@ class InferenceEngine:
             token = sample(logits[:, 0], key, sp)
             return token, cache
 
+        K = self.ecfg.decode_steps
+        tail_capable = attention is None and isinstance(
+            self.cache, (DenseKVCache, QuantizedDenseKVCache)
+        )
+
+        def _decode_scan(params, tokens, cache, active, key, sp, eos_ids, budget):
+            """``K`` fused decode steps in one dispatch: sampling, EOS stops,
+            and per-row token budgets all carried on device. Rows that stop
+            (EOS / budget) keep computing but write nothing (``num_new=0``)
+            and emit ``-1``. Returns ``(emitted [K, B], cache)``.
+
+            Dense cache kinds run the write-behind-tail fast path
+            (``llama.multi_decode_apply`` — big KV buffers read-only through
+            all K steps); other caches scan ``model_apply`` per step.
+            """
+            if tail_capable:
+                def step_fn(i, logits, alive):
+                    nxt = sample(logits, jax.random.fold_in(key, i), sp)
+                    emitted = jnp.where(alive, nxt, -1)
+                    alive = alive & (nxt != eos_ids) & (i + 1 < budget)
+                    return nxt, alive.astype(jnp.int32), alive, emitted
+
+                return llama.multi_decode_apply(
+                    cfg, params, tokens, cache, K, step_fn,
+                    active, active.astype(jnp.int32),
+                )
+
+            def one(carry, i):
+                tok, cache, alive = carry
+                logits, cache = llama.model_apply(
+                    cfg, params, tok, cache, alive.astype(jnp.int32), **mkw
+                )
+                nxt = sample(logits[:, 0], jax.random.fold_in(key, i), sp)
+                emitted = jnp.where(alive, nxt, -1)
+                alive = alive & (nxt != eos_ids) & (i + 1 < budget)
+                return (nxt[:, None], cache, alive), emitted
+
+            (_, cache, _), emitted = jax.lax.scan(
+                one, (tokens, cache, active), jnp.arange(K)
+            )
+            return emitted, cache
+
         donate = jax.default_backend() == "tpu"
         dk = dict(donate_argnums=(2,)) if donate else {}
         self._prefill = self._with_mesh(jax.jit(_prefill_row, **dk))
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
+        self._decode_k = self._with_mesh(jax.jit(_decode_scan, **dk))
 
     def _window_ladder(
         self, cap: Optional[int] = None, strict: bool = True
@@ -395,10 +450,14 @@ class InferenceEngine:
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             return
         if self.cache.max_len > self._windows[0]:
+            kw = (
+                {"use_kernel": self.cache.use_kernel}
+                if isinstance(self.cache, QuantizedDenseKVCache) else {}
+            )
             self.cache = type(self.cache).create(
                 self.cfg.num_layers, self.batch, self._windows[0],
                 self.cfg.num_kv_heads, self.cfg.head_dim,
-                jnp.dtype(self.ecfg.dtype),
+                jnp.dtype(self.ecfg.dtype), **kw,
             )
 
     def _admit(self, produced) -> None:
@@ -485,6 +544,7 @@ class InferenceEngine:
         self.metrics.counter("prefill_tokens", len(s.prompt) - skip)
 
     def _decode_tick(self, produced) -> None:
+        K = max(1, self.ecfg.decode_steps)
         tokens = np.zeros((self.batch, 1), np.int32)
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
         for slot, gid in enumerate(self.slots):
@@ -494,28 +554,37 @@ class InferenceEngine:
             tokens[slot, 0] = s.last_token
             opts[slot] = s.options
 
-        # Paged: grow page tables across boundaries before the step.
+        # Per-row token budget for this tick: how many of the K scan steps
+        # may actually append (remaining max_new_tokens and cache capacity).
+        budget = np.zeros((self.batch,), np.int32)
+
+        # Paged: grow page tables to cover this tick's budget before the step.
         if isinstance(self.cache, PagedKVCache):
+            ps = self.ccfg.page_size
             for slot, gid in enumerate(self.slots):
                 if gid is None:
                     continue
                 s = self.sessions[gid]
-                cap = len(s.pages) * self.ccfg.page_size
-                if s.total_len + 1 > cap:
+                want = min(K, s.options.max_new_tokens - len(s.generated))
+                while len(s.pages) * ps < s.total_len + want:
                     if (
                         len(s.pages) >= self.ccfg.max_pages_per_session
                         or self.allocator.free_count == 0
                     ):
-                        self._finish(s, "capacity", produced)
-                        continue
+                        break
                     # Widen the page table first: the new slot index must
                     # exist (a clamped update would corrupt another slot).
-                    self._ensure_capacity(len(s.pages) * self.ccfg.page_size + 1)
+                    self._ensure_capacity(len(s.pages) * ps + 1)
                     new = self.allocator.alloc(1)
                     self.cache = self.cache.assign_pages(
                         s.slot, new, start_slot=len(s.pages)
                     )
                     s.pages.extend(new)
+                cap = len(s.pages) * ps
+                if s.total_len + 1 > cap:
+                    self._finish(s, "capacity", produced)
+                    continue
+                budget[slot] = min(want, cap - s.total_len)
         elif isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             for slot, gid in enumerate(self.slots):
                 if gid is None:
@@ -523,6 +592,20 @@ class InferenceEngine:
                 s = self.sessions[gid]
                 if s.total_len + 1 > self.ecfg.max_seq_len:
                     self._finish(s, "capacity", produced)
+                    continue
+                budget[slot] = min(
+                    K,
+                    s.options.max_new_tokens - len(s.generated),
+                    self.ecfg.max_seq_len - s.total_len,
+                )
+        else:  # sink ring: unbounded stream
+            for slot, gid in enumerate(self.slots):
+                if gid is None:
+                    continue
+                s = self.sessions[gid]
+                budget[slot] = min(
+                    K, s.options.max_new_tokens - len(s.generated)
+                )
 
         active = np.array(
             [self.slots[i] is not None for i in range(self.batch)], np.bool_
@@ -531,24 +614,43 @@ class InferenceEngine:
             return
 
         if self._windows:
-            self._ensure_capacity(1 + max(
-                self.sessions[g].total_len for g in self.slots if g is not None
+            self._ensure_capacity(max(
+                self.sessions[g].total_len + int(budget[i])
+                for i, g in enumerate(self.slots) if g is not None
             ))
 
         sp = SamplingParams.stack(opts)
         with self.metrics.timer("decode_step"), span(
             "decode_step", self.spans, batch=int(active.sum()),
         ):
-            next_tokens, self.cache = self._decode(
-                self.params, jnp.asarray(tokens), self.cache,
-                jnp.asarray(active), self._next_key(), sp,
-            )
-        next_tokens = np.asarray(jax.device_get(next_tokens))
+            if K == 1:
+                next_tokens, self.cache = self._decode(
+                    self.params, jnp.asarray(tokens), self.cache,
+                    jnp.asarray(active), self._next_key(), sp,
+                )
+                emitted = np.asarray(jax.device_get(next_tokens))[None, :]
+            else:
+                eos_ids = np.asarray(
+                    [o.eos_token_id for o in opts], np.int32
+                )
+                emitted, self.cache = self._decode_k(
+                    self.params, jnp.asarray(tokens), self.cache,
+                    jnp.asarray(active), self._next_key(), sp,
+                    jnp.asarray(eos_ids), jnp.asarray(budget),
+                )
+                emitted = np.asarray(jax.device_get(emitted))
+
+        delivered = 0
         for slot, gid in enumerate(list(self.slots)):
             if gid is None or not active[slot]:
                 continue
-            self._deliver(self.sessions[gid], int(next_tokens[slot]), produced)
-        self.metrics.counter("decode_tokens", int(active.sum()))
+            s = self.sessions[gid]
+            for i in range(int(budget[slot])):
+                if s.state != SessionState.ACTIVE:
+                    break
+                self._deliver(s, int(emitted[i, slot]), produced)
+                delivered += 1
+        self.metrics.counter("decode_tokens", delivered)
 
     def _deliver(self, s: Session, token: int, produced) -> None:
         s.record_token(token)

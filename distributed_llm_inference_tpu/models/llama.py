@@ -241,6 +241,126 @@ def model_apply(
     return logits, cache.advance(num_new)
 
 
+class _TailView:
+    """Cache stand-in handed to ``_decoder_layer`` inside the fused decode
+    scan: its ``layer_state`` is the concatenation of the real cache's
+    READ-ONLY big planes and the small mutable tail planes; ``attend``
+    splits them and delegates to the cache's ``tail_attend``. Returned
+    layer state echoes the big planes unchanged (the driver writes back
+    only the tail half)."""
+
+    def __init__(self, cache, base_len, tail_len, step_idx, num_big):
+        self.cache = cache
+        self.base_len = base_len
+        self.tail_len = tail_len
+        self.step_idx = step_idx
+        self.num_big = num_big
+
+    def q_positions(self, seq_len):
+        return (self.base_len + self.tail_len)[:, None]
+
+    def rope_positions(self, seq_len, num_new):
+        return self.q_positions(seq_len)
+
+    def attend(self, layer_state, q, k_new, v_new, rope, q_pos, num_new,
+               sliding_window, attention_fn, scale=None):
+        big = layer_state[: self.num_big]
+        tail = layer_state[self.num_big:]
+        out, new_tail = self.cache.tail_attend(
+            big, tail, q, k_new, v_new, rope, self.base_len, self.tail_len,
+            self.step_idx, num_new, sliding_window, scale,
+        )
+        return out, (*big, *new_tail)
+
+
+def multi_decode_apply(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    cache,
+    num_steps: int,
+    step_fn,
+    init_state,
+    init_num_new: jnp.ndarray,
+):
+    """``num_steps`` fused decode steps with a WRITE-BEHIND KV tail.
+
+    The per-step scan path writes each new token into the big KV buffers
+    with per-row dynamic offsets — which lowers to a serial while-loop over
+    batch rows on TPU (measured ~26 ms/step at batch 80, Llama-7B shapes,
+    more than the step's entire ideal HBM traffic; a scatter instead aborts
+    under GSPMD). Here the big buffers stay READ-ONLY for all K steps: they
+    ride the layer scan as sliced operands (like the weights, which scan
+    slices for free), each step's fresh k/v lands in a small per-layer tail
+    buffer at a SCALAR slot index (one vectorized write), and the tail is
+    merged into the big buffers once at the end. Attention runs over the two
+    segments (big + tail) under one joint softmax
+    (``ops.attention.gqa_attention_segments``).
+
+    ``tokens``: ``[B, 1]`` first input tokens. ``step_fn(i, logits, state)``
+    → ``(next_tokens [B], next_num_new [B] int32, state, emit)`` carries
+    sampling/stop logic; ``num_new`` must be non-increasing per row across
+    steps (a finished row stays finished) so each row's tail slots stay
+    contiguous. Returns ``(emits stacked [K, ...], cache flushed+advanced)``.
+
+    Only the dense cache kinds implement the tail protocol
+    (``tail_init`` / ``tail_attend`` / ``tail_flush``); callers fall back to
+    per-step ``model_apply`` for other caches.
+    """
+    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    big_stacks = cache.layer_stacks
+    num_big = len(big_stacks)
+    num_stack = big_stacks[0].shape[0]
+    base_len = cache.lengths
+
+    def token_step(carry, i):
+        tokens, tail, tail_len, num_new, state = carry
+        x = jnp.take(params["embed"], tokens, axis=0)
+        view = _TailView(cache, base_len, tail_len, i, num_big)
+        q_pos = view.q_positions(1)
+        cos, sin = rope_cos_sin(q_pos, inv_freq)
+        rope = RopeAngles(inv_freq, cos, sin)
+
+        def layer_step(carry2, xs):
+            x, tail_bufs = carry2
+            p = xs[0]
+            big_state = tuple(xs[1 : 1 + num_big])
+            idx = xs[-1]
+            tail_state = tuple(
+                jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
+                for b in tail_bufs
+            )
+            out, new_state = _decoder_layer(
+                cfg, p, x, (*big_state, *tail_state), view, rope, q_pos,
+                num_new,
+            )
+            tail_bufs = tuple(
+                jax.lax.dynamic_update_index_in_dim(b, n, idx, 0)
+                for b, n in zip(tail_bufs, new_state[num_big:])
+            )
+            return (out, tail_bufs), None
+
+        (x, tail), _ = jax.lax.scan(
+            layer_step, (x, tail),
+            (params["layers"], *big_stacks, jnp.arange(num_stack)),
+        )
+        logits = apply_head(cfg, params, x)
+        next_tokens, next_num_new, state, emit = step_fn(i, logits[:, 0], state)
+        tail_len = tail_len + num_new
+        return (
+            (next_tokens[:, None], tail, tail_len, next_num_new, state), emit
+        )
+
+    zero_len = jnp.zeros_like(base_len)
+    (_, tail, tail_len, _, _), emits = jax.lax.scan(
+        token_step,
+        (tokens, cache.tail_init(num_steps), zero_len, init_num_new,
+         init_state),
+        jnp.arange(num_steps),
+    )
+    return emits, cache.tail_flush(tail, tail_len)
+
+
 def apply_head(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm + lm_head (tied to the embedding when absent): ``[..., H]``
     hidden states → fp32 logits ``[..., V]``."""

@@ -1,0 +1,212 @@
+"""Pallas decode-attention kernel over the int8-quantized dense KV cache.
+
+Why a kernel: the XLA path must feed the attention matmuls bf16 operands, so
+the int8 cache is dequantized first — and depending on layout/formulation XLA
+can materialize a full bf16 copy of K and V through HBM every step (measured
+~13 GB extra per step at batch 80, Llama-7B shapes — more than the entire
+ideal step traffic). Here the int8 buffers stream through VMEM exactly once:
+scores are computed on the int8 values and the per-(token, head) scales are
+applied to the scores (``q·(k·s_t) = s_t·(q·k)``); the v scales fold into the
+probs before PV.
+
+Structure follows ``paged_attention.py`` (grid over (batch, time-tiles),
+online-softmax scratch carried across the inner axis, VPU multiply-reduce for
+MHA / batched ``dot_general`` for GQA); the operand here is the contiguous
+HEAD-major ``[B, Hkv, T, D]`` dense buffer instead of a page pool — the same
+head-major tile shape the paged pool uses — with time-tiles past the row's
+live length clamped to tile 0 so short rows in a long batch fetch one hot
+tile instead of the padded span.
+
+This is the decode half of the int8-KV serving mode (the reference's only
+deployment optimization is int8 *weights*,
+``/root/reference/distributed_llm_inference/utils/model.py:93-123``; int8 KV
+is its TPU-native counterpart for the bandwidth-bound decode path). Runs in
+interpret mode off-TPU so the CPU test mesh exercises it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import _NEG_INF
+
+__all__ = ["quantized_decode_attention"]
+
+
+def _qdense_kernel(
+    len_ref,    # SMEM [B] int32 (scalar prefetch)
+    q_ref,      # [1, Hkv, G, D]
+    k_ref,      # [1, Hkv, BT, D] int8
+    ks_ref,     # [1, Hkv, BT] f32
+    v_ref,      # [1, Hkv, BT, D] int8
+    vs_ref,     # [1, Hkv, BT] f32
+    out_ref,    # [1, Hkv, G, D]
+    acc_ref,    # VMEM [Hkv*G, D] f32
+    m_ref,      # VMEM [Hkv*G, 128] f32
+    l_ref,      # VMEM [Hkv*G, 128] f32
+    *,
+    scale: float,
+    block_t: int,
+    num_blocks: int,
+    sliding_window: Optional[int],
+    hkv: int,
+    g: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[b]
+    pos = j * block_t + jax.lax.broadcasted_iota(jnp.int32, (1, block_t), 1)
+    valid = pos < kv_len  # decode: causality ≡ slot validity
+    if sliding_window is not None:
+        valid &= pos > kv_len - 1 - sliding_window
+
+    q = q_ref[0]                       # [Hkv, G, D]
+    k = k_ref[0]                       # [Hkv, BT, D] int8
+    ks = ks_ref[0]                     # [Hkv, BT] f32
+
+    if g == 1:
+        # MHA: VPU multiply-reduce (1-row MXU matmuls waste the array).
+        qv = q[:, 0, :][:, None, :].astype(jnp.float32)      # [Hkv, 1, D]
+        s = jnp.sum(k.astype(jnp.float32) * qv, axis=-1)     # [Hkv, BT]
+        s = s * ks
+    else:
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                    # [Hkv, G, BT]
+        s = s * ks[:, None, :]
+        s = s.reshape(hkv * g, block_t)
+    s = s * scale
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)            # [Hkv*G, BT]
+
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    v = v_ref[0]                       # [Hkv, BT, D] int8
+    vs = vs_ref[0]                     # [Hkv, BT] f32
+    if g == 1:
+        pw = p.reshape(hkv, block_t) * vs                    # [Hkv, BT]
+        pv = jnp.sum(pw[:, :, None] * v.astype(jnp.float32), axis=1)
+        acc_ref[:] = acc_ref[:] * alpha + pv                 # [Hkv, D]
+    else:
+        pw = p.reshape(hkv, g, block_t) * vs[:, None, :]
+        pv = jax.lax.dot_general(
+            pw, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(hkv * g, -1)
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
+
+
+def quantized_decode_attention(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    ks: jnp.ndarray,
+    v_q: jnp.ndarray,
+    vs: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_t: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Decode attention straight over the int8 head-major dense cache.
+
+    ``q``: ``[B, 1, Hq, D]`` (already rotated); ``k_q``/``v_q``: int8
+    ``[B, Hkv, T, D]`` (keys stored rotated); ``ks``/``vs``: f32
+    ``[B, Hkv, T]`` per-(token, head) scales; ``kv_lengths``: ``[B]`` live kv
+    count per row *including* tokens written this step. Returns
+    ``[B, 1, Hq, D]`` in q's dtype.
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode-only kernel (S=1), got S={s}")
+    hkv, t = k_q.shape[1], k_q.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bt = min(block_t, t)
+    num_blocks = -(-t // bt)
+    if t % bt:
+        pad = num_blocks * bt - t
+        k_q = jnp.pad(k_q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v_q = jnp.pad(v_q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad)))
+        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad)))
+
+    qr = q.reshape(b, hkv, g, d)
+
+    def _tile_index(bi, ji, lens):
+        # Tiles past the row's live span clamp to tile 0 (one hot fetch).
+        live = ji * bt < lens[bi]
+        return (bi, 0, jnp.where(live, ji, 0), 0)
+
+    def _tile_index3(bi, ji, lens):
+        live = ji * bt < lens[bi]
+        return (bi, 0, jnp.where(live, ji, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, num_blocks),
+        in_specs=[
+            pl.BlockSpec((1, hkv, g, d), lambda bi, ji, lens: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, bt, d), _tile_index),
+            pl.BlockSpec((1, hkv, bt), _tile_index3),
+            pl.BlockSpec((1, hkv, bt, d), _tile_index),
+            pl.BlockSpec((1, hkv, bt), _tile_index3),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hkv, g, d), lambda bi, ji, lens: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv * g, d), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _qdense_kernel,
+        scale=scale,
+        block_t=bt,
+        num_blocks=num_blocks,
+        sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(kv_lengths.astype(jnp.int32), qr, k_q, ks, v_q, vs)
+    return out.reshape(b, 1, hq, d)

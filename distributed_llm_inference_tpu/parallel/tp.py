@@ -129,9 +129,13 @@ def cache_pspecs(cache: Any, use_pp: bool = False) -> Any:
 
     pp = "pp" if use_pp else None
     if isinstance(cache, QuantizedDenseKVCache):
-        kv = P(pp, "dp", None, "tp", None)
-        sc = P(pp, "dp", None, "tp")
-        return QuantizedDenseKVCache(k=kv, v=kv, ks=sc, vs=sc, lengths=P("dp"))
+        # Head-major layout: [L, B, Hkv, T, D] — kv heads (axis 2) over tp.
+        kv = P(pp, "dp", "tp", None, None)
+        sc = P(pp, "dp", "tp", None)
+        return QuantizedDenseKVCache(
+            k=kv, v=kv, ks=sc, vs=sc, lengths=P("dp"),
+            use_kernel=cache.use_kernel,
+        )
     if isinstance(cache, DenseKVCache):
         kv = P(pp, "dp", None, "tp", None)
         return DenseKVCache(k=kv, v=kv, lengths=P("dp"))

@@ -381,3 +381,70 @@ def test_paged_table_growth_and_shrink():
     eng.generate([prompts(1, lo=3, hi=4, seed=61)[0]],
                  SamplingOptions(max_new_tokens=2))
     assert eng.cache.page_table.shape[1] < grown
+
+
+# -- multi-token on-device decode (decode_steps > 1) --------------------------
+
+
+def make_engine_k(K, kind="dense", batch=4, **cache_kw):
+    cache_defaults = dict(
+        kind=kind, page_size=8, num_pages=64, max_pages_per_session=8,
+        window_length=32, num_sink_tokens=2,
+    )
+    cache_defaults.update(cache_kw)
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(
+            max_batch_size=batch, prefill_buckets=(8, 16, 32), max_seq_len=64,
+            dtype="float32", decode_steps=K,
+        ),
+        CacheConfig(**cache_defaults),
+    )
+
+
+@pytest.mark.parametrize("kind", ["dense", "paged", "sink"])
+def test_decode_steps_matches_single_step(kind):
+    """K-step fused decode must reproduce per-token greedy decode exactly."""
+    ps = prompts(6, seed=7)
+    opts = SamplingOptions(max_new_tokens=11)  # not a multiple of K
+    ref = make_engine_k(1, kind).generate(ps, opts)
+    out = make_engine_k(4, kind).generate(ps, opts)
+    assert out == ref
+
+
+def test_decode_steps_eos_mid_scan():
+    """A row hitting EOS inside the scan stops exactly there."""
+    ps = prompts(3, seed=8)
+    ref = make_engine_k(1).generate([ps[0]], SamplingOptions(max_new_tokens=9))[0]
+    eos = ref[4]  # EOS lands mid-scan for K=4 (step 5 of 9)
+    opts = SamplingOptions(max_new_tokens=9, eos_token_id=eos)
+    ref_eng = make_engine_k(1)
+    out_eng = make_engine_k(4)
+    ref_outs = ref_eng.generate(ps, opts)
+    outs = out_eng.generate(ps, opts)
+    assert outs == ref_outs
+    assert outs[0][-1] == eos and len(outs[0]) <= 9
+    for eng in (ref_eng, out_eng):
+        for s in eng.sessions.values():
+            assert s.finish_reason in ("eos", "length")
+
+
+def test_decode_steps_paged_page_growth():
+    """K-step decode crossing page boundaries pre-allocates enough pages."""
+    ps = prompts(4, seed=9, lo=5, hi=9)
+    opts = SamplingOptions(max_new_tokens=20)  # crosses several 8-token pages
+    ref = make_engine_k(1, "paged").generate(ps, opts)
+    eng = make_engine_k(8, "paged")
+    out = eng.generate(ps, opts)
+    assert out == ref
+    assert eng.allocator.free_count == 63  # all pages reclaimed
+
+
+def test_decode_steps_capacity_finish():
+    """Dense rows stop at max_seq_len even when K overshoots it."""
+    eng = make_engine_k(8, "dense")
+    long_prompt = prompts(1, seed=10, lo=58, hi=59)[0]  # 58 + 1 + k <= 64
+    outs = eng.generate([long_prompt], SamplingOptions(max_new_tokens=50))
+    s = list(eng.sessions.values())[0]
+    assert s.finish_reason == "capacity"
+    assert len(outs[0]) <= 64 - 58

@@ -95,7 +95,8 @@ def test_quantized_cache_row_ops_and_capacity():
     assert bool(c.fits(jnp.full((4,), 16, jnp.int32)).all())
     assert not bool(c.fits(jnp.full((4,), 17, jnp.int32)).any())
     sub = c.select_row(2)
-    assert sub.k.shape == (2, 1, 16, 2, 8) and sub.ks.shape == (2, 1, 16, 2)
+    # head-major layout: [L, B, Hkv, T, D] / [L, B, Hkv, T]
+    assert sub.k.shape == (2, 1, 2, 16, 8) and sub.ks.shape == (2, 1, 2, 16)
     merged = c.merge_row(sub.advance(jnp.asarray([3], jnp.int32)), 2)
     assert int(merged.lengths[2]) == 3
     reset = merged.reset_rows(jnp.arange(4) == 2)
@@ -119,3 +120,27 @@ def test_quantized_cache_sharded_matches_single_device():
             sp, tokens, sc
         )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_pallas_kernel_engine_parity():
+    """use_pallas_attention with kv_quant='int8' routes decode through the
+    int8 VMEM-streaming kernel (interpret mode here) and matches the XLA
+    path."""
+    rng = np.random.default_rng(9)
+    reqs = [rng.integers(0, CFG.vocab_size, size=int(rng.integers(3, 10))).tolist()
+            for _ in range(4)]
+
+    def run(pallas):
+        eng = InferenceEngine(
+            CFG, PARAMS,
+            EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                         max_seq_len=64, dtype="float32",
+                         use_pallas_attention=pallas),
+            CacheConfig(kind="dense", kv_quant="int8"),
+        )
+        assert eng.cache.use_kernel == pallas
+        return eng.generate(reqs, SamplingOptions(max_new_tokens=6))
+
+    ref, out = run(False), run(True)
+    agree = sum(a == b for a, b in zip(ref, out))
+    assert agree >= len(ref) - 1, (ref, out)
