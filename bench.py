@@ -119,8 +119,17 @@ def _zero_q4params(cfg: ModelConfig):
     return _zero_tree(cfg, INT4_WEIGHTS, leaf)
 
 
-def _try_decode_bench(cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache):
-    """Decode throughput at ``batch``: tokens/sec on this one chip."""
+def _try_decode_bench(
+    cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache, scan_k=16
+):
+    """Decode throughput at ``batch``: tokens/sec on this one chip.
+
+    ``scan_k > 1`` uses the engine's fused-decode fast path
+    (``llama.multi_decode_apply`` — K steps per dispatch, big KV buffers
+    read-only with a write-behind tail), exactly what the serving engine
+    runs with ``EngineConfig.decode_steps``; ``scan_k=1`` is the per-token
+    dispatch path.
+    """
     # Buffer sized to the bucket this workload reaches (ctx//2 live + the
     # steps generated) — the serving engine's growth ladder does the same:
     # decode bandwidth tracks live context, with ctx as the virtual cap.
@@ -132,21 +141,45 @@ def _try_decode_bench(cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache)
     num_new = jnp.ones((batch,), jnp.int32)
     donate = {"donate_argnums": (2,)} if jax.default_backend() == "tpu" else {}
 
-    def decode(params, tokens, cache):
-        logits, cache = llama.model_apply(cfg, params, tokens, cache, num_new)
-        return jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None], cache
+    if scan_k > 1 and hasattr(cache, "tail_init"):
+        active = jnp.ones((batch,), bool)
+
+        def decode(params, tokens, cache):
+            def step_fn(i, logits, alive):
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return nxt, alive.astype(jnp.int32), alive, nxt
+
+            emits, cache = llama.multi_decode_apply(
+                cfg, params, tokens, cache, scan_k, step_fn, active,
+                active.astype(jnp.int32),
+            )
+            return emits[-1][:, None], cache
+
+        tokens_per_call = scan_k
+    else:
+        def decode(params, tokens, cache):
+            logits, cache = llama.model_apply(
+                cfg, params, tokens, cache, num_new
+            )
+            return (
+                jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None],
+                cache,
+            )
+
+        tokens_per_call = 1
 
     decode = jax.jit(decode, **donate)
 
+    calls = max(1, steps // tokens_per_call)
     tokens = jnp.zeros((batch, 1), jnp.int32)
     tokens, cache = decode(params, tokens, cache)  # compile + warm
     jax.block_until_ready(tokens)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(calls):
         tokens, cache = decode(params, tokens, cache)
     jax.block_until_ready(tokens)
     dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return batch * calls * tokens_per_call / dt
 
 
 def _ttft_bench(cfg, params, prompt_len=128, reps=5, cache_cls=DenseKVCache):
@@ -172,37 +205,100 @@ def _ttft_bench(cfg, params, prompt_len=128, reps=5, cache_cls=DenseKVCache):
 
 
 def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
-    """Largest-batch decode throughput that fits; ``(tok_s, batch)``."""
+    """Largest-batch decode throughput that fits; ``(tok_s, batch)``.
+
+    Each batch tries the fused K-step path first, then per-token dispatch:
+    besides OOM on the tight 7B-in-16GB fit, some (shape, K) points crash
+    the platform's remote AOT compiler (HTTP 500), and the per-token
+    executable usually still compiles there.
+    """
     err = None
     for batch, ctx in ladder:
-        try:
-            return (
-                _try_decode_bench(cfg, params, batch, ctx, cache_cls=cache_cls),
-                batch,
-            )
-        except Exception as e:  # OOM on the tight 7B-in-16GB fit
-            # repr, not the exception: a held traceback pins the failed
-            # attempt's device buffers and starves the smaller-batch retry.
-            err = repr(e)
-            continue
+        for scan_k in (16, 1):
+            try:
+                return (
+                    _try_decode_bench(
+                        cfg, params, batch, ctx, cache_cls=cache_cls,
+                        scan_k=scan_k,
+                    ),
+                    batch,
+                )
+            except Exception as e:
+                # repr, not the exception: a held traceback pins the failed
+                # attempt's device buffers and starves the next retry.
+                err = repr(e)
+                continue
     raise RuntimeError(f"all decode configs failed: {err}")
+
+
+def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32):
+    """Per-token decode over the paged pool with the Pallas paged-attention
+    kernel reading pages in place (the long-fragmented-context serving
+    configuration; no write-behind tail — pages are the anti-padding
+    mechanism)."""
+    from distributed_llm_inference_tpu.cache.paged import (
+        PageAllocator,
+        PagedKVCache,
+    )
+
+    ps = 64
+    buf = min(ctx, ctx // 2 + steps)
+    slots = -(-buf // ps)
+    num_pages = batch * slots + 1
+    cache = PagedKVCache.create(
+        cfg.num_layers, batch, num_pages, ps, slots, cfg.num_kv_heads,
+        cfg.head_dim, use_kernel=jax.default_backend() == "tpu",
+    )
+    alloc = PageAllocator(num_pages)
+    for row in range(batch):
+        cache = cache.assign_pages(row, alloc.alloc(slots))
+    cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
+    num_new = jnp.ones((batch,), jnp.int32)
+    donate = {"donate_argnums": (2,)} if jax.default_backend() == "tpu" else {}
+
+    def decode(params, tokens, cache):
+        logits, cache = llama.model_apply(cfg, params, tokens, cache, num_new)
+        return jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None], cache
+
+    decode = jax.jit(decode, **donate)
+    tokens = jnp.zeros((batch, 1), jnp.int32)
+    tokens, cache = decode(params, tokens, cache)
+    jax.block_until_ready(tokens)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tokens, cache = decode(params, tokens, cache)
+    jax.block_until_ready(tokens)
+    return batch * steps / (time.perf_counter() - t0)
 
 
 # Weight config → (param builder, decode batch ladder, KV cache class).
 # Each phase runs in its own SUBPROCESS: the 7B-in-16GB fits are tight enough
 # that a prior phase's allocator state (fragmentation + anything an OOMed
 # attempt left pinned) starves the next phase even after jax.clear_caches().
+# All dense phases decode through the fused K-step tail path
+# (EngineConfig.decode_steps' fast path); "paged" marks the paged-kernel
+# phase. NOTE: some (batch, shape) points crash the platform's remote
+# compiler (e.g. batch 80 at 7B int8+kvq) — the ladder skips them.
 PHASES = {
     "bf16": (_zero_params, ((8, 256), (4, 256), (2, 256), (1, 256)),
              DenseKVCache),
-    "int8": (_zero_qparams, ((32, 256), (16, 256), (8, 256), (1, 256)),
+    "int8": (_zero_qparams, ((48, 256), (32, 256), (16, 256), (1, 256)),
              DenseKVCache),
     "int4": (_zero_q4params, ((64, 256), (32, 256), (16, 256), (1, 256)),
              DenseKVCache),
     # int8 weights + int8 KV (per-token/head scales): the KV working set
     # dominates HBM traffic at large batch, so halving it moves the headline.
-    "int8_kvq": (_zero_qparams, ((80, 256), (64, 256), (32, 256), (1, 256)),
+    "int8_kvq": (_zero_qparams,
+                 ((112, 256), (96, 256), (64, 256), (32, 256), (1, 256)),
                  QuantizedDenseKVCache),
+    # int4 weights + int8 KV: weight bytes halve again, freeing HBM for
+    # larger batches on the same chip.
+    "int4_kvq": (_zero_q4params,
+                 ((128, 256), (112, 256), (96, 256), (64, 256), (32, 256)),
+                 QuantizedDenseKVCache),  # peaks at b128; b144+ hits a layout cliff
+    # int8 weights + Pallas paged-attention kernel over the page pool.
+    "paged_pallas": (_zero_qparams, ((48, 256), (32, 256), (16, 256)),
+                     "paged"),
 }
 
 
@@ -212,8 +308,20 @@ def run_phase(name: str) -> dict:
     build, ladder, cache_cls = PHASES[name]
     params = build(cfg)
     jax.block_until_ready(params)
-    tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)
-    ttft = _ttft_bench(cfg, params, cache_cls=cache_cls)
+    if cache_cls == "paged":
+        err = None
+        for batch, ctx in ladder:
+            try:
+                tok_s = _try_paged_decode_bench(cfg, params, batch, ctx)
+                break
+            except Exception as e:
+                err = repr(e)
+        else:
+            raise RuntimeError(f"all paged configs failed: {err}")
+        ttft = _ttft_bench(cfg, params)
+    else:
+        tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)
+        ttft = _ttft_bench(cfg, params, cache_cls=cache_cls)
     return {
         "tok_s": round(tok_s, 2), "batch": batch, "ttft_ms": round(ttft, 2),
         "backend": jax.default_backend(),

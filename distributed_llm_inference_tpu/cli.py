@@ -164,18 +164,9 @@ def cmd_local(args) -> int:
     from .utils import checkpoint
 
     prompt, tok = _resolve_prompt(args)
-    if args.speculative_draft:
-        # Greedy-only path with its own dense caches: reject flags it would
-        # otherwise silently ignore.
-        if args.temperature:
-            raise SystemExit("--speculative-draft is greedy-only "
-                             "(remove --temperature)")
-        if args.quantize or args.int8:
-            raise SystemExit("--speculative-draft does not support weight "
-                             "quantization yet")
-        if args.cache != "paged" or args.max_sessions != 8:
-            raise SystemExit("--speculative-draft runs bs=1 with its own "
-                             "dense caches; remove --cache/--max-sessions")
+    if args.speculative_draft and args.temperature:
+        raise SystemExit("--speculative-draft is greedy-only "
+                         "(remove --temperature)")
     cfg = checkpoint.load_config(args.model)
     params = checkpoint.load_model_params(
         args.model, cfg, jnp.dtype(args.dtype), cache_dir=args.weights_cache
@@ -184,49 +175,49 @@ def cmd_local(args) -> int:
 
     extra = {}
     t0 = time.monotonic()
+    draft = None
     if args.speculative_draft:
-        from .engine.speculative import SpeculativeDecoder
-
         dcfg = checkpoint.load_config(args.speculative_draft)
         dparams = checkpoint.load_model_params(
             args.speculative_draft, dcfg, jnp.dtype(args.dtype),
             cache_dir=args.weights_cache,
         )
-        dec = SpeculativeDecoder(
-            cfg, params, dcfg, dparams, k=args.speculative_k,
-            max_seq_len=args.max_seq_len, dtype=jnp.dtype(args.dtype),
-        )
-        with profile_trace(args.profile_dir):
-            out = dec.generate(prompt, max_new_tokens=args.max_new,
-                               eos_token_id=args.eos)
-        extra["speculative"] = {
-            **dec.stats, "acceptance_rate": round(dec.acceptance_rate, 4),
-        }
-    else:
-        engine = InferenceEngine(
-            cfg, params,
-            EngineConfig(
-                max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
-                max_new_tokens=args.max_new, dtype=args.dtype,
-                quantization=args.quantize or ("int8" if args.int8 else None),
+        draft = (dcfg, dparams)
+    engine = InferenceEngine(
+        cfg, params,
+        EngineConfig(
+            max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
+            max_new_tokens=args.max_new, dtype=args.dtype,
+            quantization=args.quantize or ("int8" if args.int8 else None),
+            speculative_k=args.speculative_k if draft else 0,
+        ),
+        CacheConfig(kind=args.cache),
+        draft=draft,
+    )
+    with profile_trace(args.profile_dir):
+        out = engine.generate(
+            [prompt],
+            SamplingOptions(
+                temperature=args.temperature, max_new_tokens=args.max_new,
+                eos_token_id=args.eos if args.eos is not None else -1,
+                speculative=draft is not None,
             ),
-            CacheConfig(kind=args.cache),
-        )
-        with profile_trace(args.profile_dir):
-            out = engine.generate(
-                [prompt],
-                SamplingOptions(
-                    temperature=args.temperature, max_new_tokens=args.max_new,
-                    eos_token_id=args.eos if args.eos is not None else -1,
-                ),
-            )[0]
-        if args.profile_dir:
-            import os
+        )[0]
+    if args.profile_dir:
+        import os
 
-            engine.spans.dump_chrome_trace(
-                os.path.join(args.profile_dir, "host_spans.json")
-            )
-        extra["metrics"] = engine.metrics.snapshot()
+        engine.spans.dump_chrome_trace(
+            os.path.join(args.profile_dir, "host_spans.json")
+        )
+    extra["metrics"] = engine.metrics.snapshot()
+    if draft is not None:
+        st = engine.spec_stats
+        extra["speculative"] = {
+            **st,
+            "acceptance_rate": round(
+                st["accepted"] / max(st["proposed"], 1), 4
+            ),
+        }
     doc = {
         "event": "generated", "prompt": prompt, "tokens": out,
         "seconds": round(time.monotonic() - t0, 3), **extra,

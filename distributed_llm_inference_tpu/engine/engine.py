@@ -61,11 +61,20 @@ class InferenceEngine:
         rng: Optional[jax.Array] = None,
         attention_fn=None,
         mesh_cfg=None,
+        draft=None,
     ):
-        """``mesh_cfg`` (a :class:`MeshConfig`, model-parallel axes only —
-        tp/ep) serves one model replica sharded across chips: params and
-        cache get their NamedShardings and GSPMD partitions every jitted
-        step; the scheduler is untouched (batch rows stay replicated)."""
+        """``mesh_cfg`` (a :class:`MeshConfig`) serves one sharded deployment
+        of the model: tp/ep shard within a replica, dp shards batch rows, and
+        pp>1 runs the GPipe-staged pipeline program per batched step; the
+        scheduler is untouched either way.
+
+        ``draft = (draft_cfg, draft_params)`` enables speculative decoding
+        for sessions that opt in via ``SamplingOptions.speculative`` (greedy
+        rows only): the draft proposes ``EngineConfig.speculative_k`` tokens
+        and the target verifies them in ONE forward, with speculative and
+        normal sessions sharing that same batched step (normal rows run it
+        as a plain 1-token decode via per-row ``num_new`` masking). Output
+        is identical to non-speculative greedy decoding."""
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
         if self.ecfg.quantization in ("int8", "int4"):
@@ -119,9 +128,12 @@ class InferenceEngine:
             # (one pad-copy per growth) as sequences lengthen. Decode
             # bandwidth tracks the LIVE context, not max_seq_len: a padded
             # max-size buffer costs ~30% of decode throughput at 7B shapes
-            # early in long-context serving. Growth re-creates buffers, which
-            # would drop mesh shardings — fixed-size when serving sharded.
-            self._windows = () if mesh_cfg is not None else self._window_ladder()
+            # early in long-context serving. Growth re-creates buffers and
+            # re-applies the mesh shardings (_reshard_cache); pp/dp meshes
+            # stay fixed-size (the pipelined program's specs are
+            # shape-coupled).
+            grow_ok = mesh_cfg is None or (mesh_cfg.pp == 1 and mesh_cfg.dp == 1)
+            self._windows = self._window_ladder() if grow_ok else ()
             first = self._windows[0] if self._windows else self.ecfg.max_seq_len
             self.cache = cache_cls.create(
                 cfg.num_layers, b, first, cfg.num_kv_heads,
@@ -134,7 +146,8 @@ class InferenceEngine:
             # live length. Start narrow and pad columns as sessions lengthen
             # (cheap: the table is tiny and the pool never moves);
             # max_pages_per_session is the virtual cap.
-            self._windows = () if mesh_cfg is not None else self._window_ladder(
+            grow_ok = mesh_cfg is None or (mesh_cfg.pp == 1 and mesh_cfg.dp == 1)
+            self._windows = () if not grow_ok else self._window_ladder(
                 cap=min(self.ecfg.max_seq_len,
                         cc.max_pages_per_session * cc.page_size),
                 strict=False,  # a small paged capacity caps dense-tuned
@@ -160,24 +173,43 @@ class InferenceEngine:
             raise ValueError(f"unknown cache kind {cc.kind}")
 
         self.mesh = None
+        self._use_pp = False
+        self._cache_pspecs = None
         if mesh_cfg is not None:
             from ..parallel import (
                 build_mesh, cache_pspecs, param_pspecs, shard_pytree,
                 validate_tp,
             )
 
-            if mesh_cfg.dp != 1 or mesh_cfg.pp != 1 or mesh_cfg.sp != 1:
+            if mesh_cfg.sp != 1:
                 raise ValueError(
-                    "engine mesh serves ONE replica: only tp/ep axes are "
-                    f"supported here (got {mesh_cfg})"
+                    "sequence parallelism is a prefill-side program "
+                    f"(parallel/ring.py), not an engine axis (got {mesh_cfg})"
+                )
+            if mesh_cfg.pp > 1 and cc.kind != "dense":
+                raise ValueError(
+                    f"pp>1 serving requires the dense cache (got {cc.kind!r})"
+                )
+            if self.batch % (mesh_cfg.pp * mesh_cfg.dp) != 0:
+                raise ValueError(
+                    f"max_batch_size {self.batch} must divide by pp*dp = "
+                    f"{mesh_cfg.pp}*{mesh_cfg.dp} (microbatch row groups)"
+                )
+            if mesh_cfg.pp > 1 and cfg.num_layers % mesh_cfg.pp != 0:
+                raise ValueError(
+                    f"num_layers {cfg.num_layers} not divisible by "
+                    f"pp={mesh_cfg.pp}"
                 )
             validate_tp(cfg, mesh_cfg.tp, ep=mesh_cfg.ep)
+            self._use_pp = mesh_cfg.pp > 1
             self.mesh = build_mesh(mesh_cfg)
             self.params = shard_pytree(
-                self.params, self.mesh, param_pspecs(self.params)
+                self.params, self.mesh, param_pspecs(self.params, self._use_pp)
             )
+            self._cache_pspecs = lambda c: cache_pspecs(c, self._use_pp)
+            self._shard_pytree = shard_pytree
             self.cache = shard_pytree(
-                self.cache, self.mesh, cache_pspecs(self.cache)
+                self.cache, self.mesh, self._cache_pspecs(self.cache)
             )
 
         self.sessions: Dict[str, Session] = {}
@@ -196,6 +228,24 @@ class InferenceEngine:
 
             attention = flash_attention  # falls back to XLA on decode shapes
         mkw = {} if attention is None else {"attention_fn": attention}
+        # pp>1: batched steps run the GPipe-staged pipeline program
+        # (parallel/pipeline.py). Single-row prefill cannot microbatch (one
+        # row), so it keeps the plain program — GSPMD streams each pp stage's
+        # layer weights to the computation, which for a once-per-admission
+        # bucket-sized prefill is an acceptable ICI cost.
+        batch_mkw = dict(mkw)
+        if self._use_pp:
+            from ..parallel.pipeline import pipeline_block_apply
+
+            mesh = self.mesh
+            pkw = dict(mkw)
+
+            def _pp_block_fn(cfg_, layers_, x_, cache_, num_new_):
+                return pipeline_block_apply(
+                    cfg_, layers_, x_, cache_, num_new_, mesh, **pkw
+                )
+
+            batch_mkw["block_fn"] = _pp_block_fn
 
         def _prefill_row(params, tokens, cache, row, n_valid, key, sp):
             # ``row`` and ``n_valid`` are traced: one compile per prefill
@@ -217,14 +267,20 @@ class InferenceEngine:
 
         def _decode_step(params, tokens, cache, active, key, sp):
             logits, cache = llama.model_apply(
-                cfg, params, tokens, cache, active.astype(jnp.int32), **mkw
+                cfg, params, tokens, cache, active.astype(jnp.int32),
+                **batch_mkw,
             )
             token = sample(logits[:, 0], key, sp)
             return token, cache
 
         K = self.ecfg.decode_steps
-        tail_capable = attention is None and isinstance(
-            self.cache, (DenseKVCache, QuantizedDenseKVCache)
+        # The write-behind tail composes with tp/ep/dp sharding (its scalar
+        # slot writes and flush gather partition) but not with the staged
+        # pipeline program, which pp engines use per step instead.
+        tail_capable = (
+            attention is None
+            and not self._use_pp
+            and isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
         )
 
         def _decode_scan(params, tokens, cache, active, key, sp, eos_ids, budget):
@@ -252,7 +308,8 @@ class InferenceEngine:
             def one(carry, i):
                 tok, cache, alive = carry
                 logits, cache = llama.model_apply(
-                    cfg, params, tok, cache, alive.astype(jnp.int32), **mkw
+                    cfg, params, tok, cache, alive.astype(jnp.int32),
+                    **batch_mkw,
                 )
                 nxt = sample(logits[:, 0], jax.random.fold_in(key, i), sp)
                 emitted = jnp.where(alive, nxt, -1)
@@ -270,6 +327,75 @@ class InferenceEngine:
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
         self._decode_k = self._with_mesh(jax.jit(_decode_scan, **dk))
+
+        # -- speculative decoding (draft model; BASELINE config 5) ------------
+        self.draft = None
+        self.spec_stats = {"proposed": 0, "accepted": 0, "steps": 0}
+        if draft is not None:
+            dcfg, dparams = draft
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft and target must share a vocabulary")
+            if isinstance(self.cache, SinkKVCache):
+                raise ValueError(
+                    "speculative decoding needs rollback-capable caches "
+                    "(dense/paged); the sink ring evicts on write"
+                )
+            if self.ecfg.speculative_k < 1:
+                raise ValueError(
+                    f"speculative_k must be >= 1 with a draft model, got "
+                    f"{self.ecfg.speculative_k}"
+                )
+            self.draft = (dcfg, dparams)
+            sk = self.ecfg.speculative_k
+            self.draft_cache = DenseKVCache.create(
+                dcfg.num_layers, b, self.ecfg.max_seq_len, dcfg.num_kv_heads,
+                dcfg.head_dim, dtype,
+            )
+
+            def _draft_prefill_row(dp_, tokens, dcache, row, n_valid):
+                sub = dcache.select_row(row)
+                _, sub = llama.model_apply(dcfg, dp_, tokens, sub, n_valid[None])
+                return dcache.merge_row(sub, row)
+
+            def _draft_propose(dp_, tokens, dcache, active):
+                """k greedy draft tokens per active row; draft cache
+                advances k for active rows."""
+                def one(carry, _):
+                    tok, dc = carry
+                    logits, dc = llama.model_apply(
+                        dcfg, dp_, tok, dc, active.astype(jnp.int32)
+                    )
+                    nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+                    return (nxt[:, None], dc), nxt
+
+                (_, dcache), toks = jax.lax.scan(
+                    one, (tokens, dcache), None, length=sk
+                )
+                return toks, dcache  # [k, B]
+
+            def _draft_catchup(dp_, tokens, dcache, mask):
+                _, dcache = llama.model_apply(
+                    dcfg, dp_, tokens, dcache, mask.astype(jnp.int32)
+                )
+                return dcache
+
+            def _verify(params_, seq, cache, num_new, key, sp):
+                """One target forward over [last, p1..pk] (speculative rows,
+                num_new=k+1) and [last, pad…] (normal rows, num_new=1).
+                Returns per-position argmax (acceptance), the position-0
+                sample (normal rows' token), and the cache (advanced
+                per-row; the caller rolls speculative rows back)."""
+                logits, cache = llama.model_apply(
+                    cfg, params_, seq, cache, num_new, **batch_mkw
+                )
+                preds = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k+1]
+                sampled = sample(logits[:, 0], key, sp)
+                return preds, sampled, cache
+
+            self._draft_prefill = jax.jit(_draft_prefill_row, **dk)
+            self._draft_propose = jax.jit(_draft_propose, **dk)
+            self._draft_catchup = jax.jit(_draft_catchup, **dk)
+            self._verify = self._with_mesh(jax.jit(_verify, **dk))
 
     def _window_ladder(
         self, cap: Optional[int] = None, strict: bool = True
@@ -303,6 +429,7 @@ class InferenceEngine:
                 self.cache = self.cache.replace(page_table=jnp.pad(
                     self.cache.page_table, ((0, 0), (0, pad))
                 ))
+                self._reshard_cache()
                 self.metrics.counter("cache_growths")
             return
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
@@ -312,7 +439,17 @@ class InferenceEngine:
             self.ecfg.max_seq_len,
         )
         self.cache = self.cache.grow_to(new_t)
+        self._reshard_cache()
         self.metrics.counter("cache_growths")
+
+    def _reshard_cache(self) -> None:
+        """Re-apply the mesh shardings after a growth/shrink re-created the
+        cache buffers (new arrays come back default-sharded; leaving them so
+        would silently replicate the cache and serialize every step)."""
+        if self.mesh is not None:
+            self.cache = self._shard_pytree(
+                self.cache, self.mesh, self._cache_pspecs(self.cache)
+            )
 
     def _with_mesh(self, fn):
         """Run a jitted step inside the mesh context when serving sharded."""
@@ -446,6 +583,7 @@ class InferenceEngine:
                 self.cache = self.cache.replace(
                     page_table=self.cache.page_table[:, :self._first_slots]
                 )
+                self._reshard_cache()
             return
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
             return
@@ -459,6 +597,7 @@ class InferenceEngine:
                 self.cfg.num_kv_heads, self.cfg.head_dim,
                 jnp.dtype(self.ecfg.dtype), **kw,
             )
+            self._reshard_cache()
 
     def _admit(self, produced) -> None:
         self._shrink_if_idle()
@@ -478,6 +617,10 @@ class InferenceEngine:
             # Reset the row BEFORE installing pages (reset wipes the row's
             # page table).
             self.cache = self.cache.reset_rows(jnp.arange(self.batch) == slot)
+            if self.draft is not None:
+                self.draft_cache = self.draft_cache.reset_rows(
+                    jnp.arange(self.batch) == slot
+                )
             shared_len = 0
             if isinstance(self.cache, PagedKVCache):
                 ps = self.ccfg.page_size
@@ -542,8 +685,41 @@ class InferenceEngine:
             )
         self._deliver(s, int(token), produced)
         self.metrics.counter("prefill_tokens", len(s.prompt) - skip)
+        if self._session_speculative(s):
+            # Mirror the FULL prompt into the draft cache (no prefix sharing
+            # there; proposals start right after the prompt).
+            dparams = self.draft[1]
+            cap = self.ecfg.prefill_buckets[-1]
+            off = 0
+            while len(prompt) - off > cap:
+                chunk = prompt[off : off + cap]
+                self.draft_cache = self._draft_prefill(
+                    dparams, jnp.asarray(chunk)[None, :], self.draft_cache,
+                    s.slot, jnp.int32(len(chunk)),
+                )
+                off += cap
+            rest = prompt[off:]
+            bucket = self._bucket_for(len(rest))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(rest)] = rest
+            self.draft_cache = self._draft_prefill(
+                dparams, jnp.asarray(padded), self.draft_cache, s.slot,
+                jnp.int32(len(rest)),
+            )
+
+    def _session_speculative(self, s: Session) -> bool:
+        return (
+            self.draft is not None
+            and s.options.speculative
+            and s.options.temperature == 0.0
+        )
 
     def _decode_tick(self, produced) -> None:
+        if self.draft is not None and any(
+            g is not None and self._session_speculative(self.sessions[g])
+            for g in self.slots
+        ):
+            return self._speculative_tick(produced)
         K = max(1, self.ecfg.decode_steps)
         tokens = np.zeros((self.batch, 1), np.int32)
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
@@ -560,29 +736,13 @@ class InferenceEngine:
 
         # Paged: grow page tables to cover this tick's budget before the step.
         if isinstance(self.cache, PagedKVCache):
-            ps = self.ccfg.page_size
             for slot, gid in enumerate(self.slots):
                 if gid is None:
                     continue
                 s = self.sessions[gid]
                 want = min(K, s.options.max_new_tokens - len(s.generated))
-                while len(s.pages) * ps < s.total_len + want:
-                    if (
-                        len(s.pages) >= self.ccfg.max_pages_per_session
-                        or self.allocator.free_count == 0
-                    ):
-                        break
-                    # Widen the page table first: the new slot index must
-                    # exist (a clamped update would corrupt another slot).
-                    self._ensure_capacity(len(s.pages) * ps + 1)
-                    new = self.allocator.alloc(1)
-                    self.cache = self.cache.assign_pages(
-                        s.slot, new, start_slot=len(s.pages)
-                    )
-                    s.pages.extend(new)
-                cap = len(s.pages) * ps
-                if s.total_len + 1 > cap:
-                    self._finish(s, "capacity", produced)
+                cap = self._grow_pages_for(s, want, produced)
+                if cap is None:
                     continue
                 budget[slot] = min(want, cap - s.total_len)
         elif isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
@@ -651,6 +811,182 @@ class InferenceEngine:
                 self._deliver(s, int(emitted[i, slot]), produced)
                 delivered += 1
         self.metrics.counter("decode_tokens", delivered)
+
+    def _grow_pages_for(self, s: Session, want: int, produced) -> Optional[int]:
+        """Grow ``s``'s page run to cover ``want`` more tokens (best effort);
+        returns the mapped capacity, or None if the session was finished for
+        lacking room for even one token. Shared by the plain and speculative
+        ticks so the table-widen-before-assign invariant lives once."""
+        ps = self.ccfg.page_size
+        while len(s.pages) * ps < s.total_len + want:
+            if (
+                len(s.pages) >= self.ccfg.max_pages_per_session
+                or self.allocator.free_count == 0
+            ):
+                break
+            # Widen the page table first: the new slot index must exist
+            # (a clamped update would corrupt another slot).
+            self._ensure_capacity(len(s.pages) * ps + 1)
+            new = self.allocator.alloc(1)
+            self.cache = self.cache.assign_pages(
+                s.slot, new, start_slot=len(s.pages)
+            )
+            s.pages.extend(new)
+        cap = len(s.pages) * ps
+        if s.total_len + 1 > cap:
+            self._finish(s, "capacity", produced)
+            return None
+        return cap
+
+    def _speculative_tick(self, produced) -> None:
+        """Draft-propose + ONE-forward verify (greedy speculation): the
+        target checks all k proposals in a single k+1-position step — k
+        sequential HBM sweeps become one on the bandwidth-bound decode path.
+        Acceptance = longest agreeing argmax prefix + the target's own token
+        at the first disagreement, so output is IDENTICAL to plain greedy
+        decoding. Normal (non-speculative) sessions ride the same verify
+        step as a 1-token decode via per-row ``num_new``; cache rollback is
+        a per-row ``lengths`` decrement (validity derives from lengths)."""
+        k = self.ecfg.speculative_k
+        b = self.batch
+        tokens = np.zeros((b, 1), np.int32)
+        opts: List[SamplingOptions] = [SamplingOptions()] * b
+        spec = np.zeros((b,), np.bool_)
+        for slot, gid in enumerate(self.slots):
+            if gid is None:
+                continue
+            s = self.sessions[gid]
+            tokens[slot, 0] = s.last_token
+            opts[slot] = s.options
+            spec[slot] = self._session_speculative(s)
+
+        # Capacity: speculative rows need k+1 positions this tick, normal
+        # rows 1; a row short of k+1 (but not of 1) decodes plainly (the
+        # draft is caught up below so speculation can resume in sync).
+        if isinstance(self.cache, PagedKVCache):
+            for slot, gid in enumerate(self.slots):
+                if gid is None:
+                    continue
+                s = self.sessions[gid]
+                cap = self._grow_pages_for(
+                    s, (k + 1) if spec[slot] else 1, produced
+                )
+                if cap is None:
+                    continue
+                if spec[slot] and s.total_len + k + 1 > cap:
+                    spec[slot] = False
+        else:
+            for slot, gid in enumerate(self.slots):
+                if gid is None:
+                    continue
+                s = self.sessions[gid]
+                if s.total_len + 1 > self.ecfg.max_seq_len:
+                    self._finish(s, "capacity", produced)
+                    continue
+                if spec[slot] and s.total_len + k + 1 > self.ecfg.max_seq_len:
+                    spec[slot] = False
+
+        active = np.array([g is not None for g in self.slots], np.bool_)
+        if not active.any():
+            return
+        if self._windows:
+            self._ensure_capacity(max(
+                self.sessions[g].total_len + ((k + 1) if spec[i] else 1)
+                for i, g in enumerate(self.slots) if g is not None
+            ))
+
+        dparams = self.draft[1]
+        if (active & spec).any():
+            prop_d, self.draft_cache = self._draft_propose(
+                dparams, jnp.asarray(tokens), self.draft_cache,
+                jnp.asarray(active & spec),
+            )
+            prop = np.asarray(jax.device_get(prop_d)).T  # [B, k]
+        else:
+            # Every speculative row was capacity-disabled this tick: skip
+            # the k draft forwards (the verify below degrades to a plain
+            # batched decode with k unused positions).
+            prop = np.zeros((b, k), np.int32)
+
+        seq = np.zeros((b, k + 1), np.int32)
+        seq[:, 0] = tokens[:, 0]
+        seq[:, 1:] = np.where(spec[:, None], prop, 0)
+        num_new = np.where(active, np.where(spec, k + 1, 1), 0).astype(
+            np.int32
+        )
+        sp = SamplingParams.stack(opts)
+        with self.metrics.timer("decode_step"), span(
+            "speculative_step", self.spans, batch=int(active.sum()),
+        ):
+            preds_d, sampled_d, self.cache = self._verify(
+                self.params, jnp.asarray(seq), self.cache,
+                jnp.asarray(num_new), self._next_key(), sp,
+            )
+        preds = np.asarray(jax.device_get(preds_d))
+        sampled = np.asarray(jax.device_get(sampled_d))
+
+        rollback = np.zeros((b,), np.int32)
+        d_rollback = np.zeros((b,), np.int32)
+        catch_mask = np.zeros((b,), np.int32)
+        catch_tok = np.zeros((b, 1), np.int32)
+        delivered = 0
+        for slot, gid in enumerate(list(self.slots)):
+            if gid is None or not active[slot]:
+                continue
+            s = self.sessions[gid]
+            if spec[slot]:
+                a = 0
+                while a < k and prop[slot, a] == preds[slot, a]:
+                    a += 1
+                emitted = [int(t) for t in prop[slot, :a]]
+                emitted.append(int(preds[slot, a]) if a < k
+                               else int(preds[slot, k]))
+                rollback[slot] = k - a
+                if a == k:
+                    # Full acceptance: the draft never consumed its own
+                    # final proposal — catch it up below.
+                    catch_mask[slot] = 1
+                    catch_tok[slot, 0] = prop[slot, -1]
+                else:
+                    d_rollback[slot] = k - a - 1
+                self.spec_stats["proposed"] += k
+                self.spec_stats["accepted"] += a
+                self.spec_stats["steps"] += 1
+            else:
+                emitted = [int(sampled[slot])]
+            for t in emitted:
+                if s.state != SessionState.ACTIVE:
+                    break
+                self._deliver(s, t, produced)
+                delivered += 1
+            if (
+                not spec[slot]
+                and self._session_speculative(s)
+                and s.state == SessionState.ACTIVE
+            ):
+                # A speculative session that decoded plainly this tick
+                # (capacity pressure): its draft cache did not see the
+                # consumed token — catch it up, or every later proposal is
+                # positionally garbage (speculation cost with ~0 acceptance).
+                catch_mask[slot] = 1
+                catch_tok[slot, 0] = tokens[slot, 0]
+        self.metrics.counter("decode_tokens", delivered)
+
+        # Roll lengths back to the true sequence (rejected positions become
+        # invisible). The draft over-ran by k-a-1 on partial acceptance.
+        if rollback.any():
+            self.cache = self.cache.replace(
+                lengths=self.cache.lengths - jnp.asarray(rollback)
+            )
+        if d_rollback.any():
+            self.draft_cache = self.draft_cache.replace(
+                lengths=self.draft_cache.lengths - jnp.asarray(d_rollback)
+            )
+        if catch_mask.any():
+            self.draft_cache = self._draft_catchup(
+                dparams, jnp.asarray(catch_tok), self.draft_cache,
+                jnp.asarray(catch_mask),
+            )
 
     def _deliver(self, s: Session, token: int, produced) -> None:
         s.record_token(token)

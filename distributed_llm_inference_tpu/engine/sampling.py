@@ -54,6 +54,9 @@ class SamplingOptions:
     top_p: float = 1.0
     max_new_tokens: int = 128
     eos_token_id: int = -1  # -1 = never stop on EOS
+    # Opt in to draft-model speculative decoding (engines constructed with a
+    # draft model only; greedy rows only — stochastic rows decode normally).
+    speculative: bool = False
 
 
 _NEG = jnp.float32(-1e30)

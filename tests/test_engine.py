@@ -250,14 +250,59 @@ def test_engine_tp_mesh_matches_single_device():
     assert sharded_eng.generate(reqs, SamplingOptions(max_new_tokens=6)) == plain
 
 
-def test_engine_mesh_rejects_batch_axes():
+def test_engine_mesh_rejects_bad_configs():
     from distributed_llm_inference_tpu.config import MeshConfig
 
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError):  # sp is a prefill program, not an axis
         InferenceEngine(
             CFG, PARAMS, EngineConfig(max_batch_size=2, dtype="float32"),
+            CacheConfig(kind="dense"), mesh_cfg=MeshConfig(sp=2),
+        )
+    with pytest.raises(ValueError):  # batch must divide by pp*dp
+        InferenceEngine(
+            CFG, PARAMS, EngineConfig(max_batch_size=3, dtype="float32"),
             CacheConfig(kind="dense"), mesh_cfg=MeshConfig(dp=2),
         )
+    with pytest.raises(ValueError):  # pp needs the dense cache
+        InferenceEngine(
+            CFG, PARAMS, EngineConfig(max_batch_size=4, dtype="float32"),
+            CacheConfig(kind="paged"), mesh_cfg=MeshConfig(pp=2),
+        )
+
+
+def test_engine_tp_pp_dp_continuous_batching_matches_solo():
+    """BASELINE config 5's serving shape: a tp=2 x pp=2 x dp=2 mesh under
+    the UNCHANGED continuous-batching scheduler reproduces solo tokens."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    ps = prompts(7, seed=11)
+    opts = SamplingOptions(max_new_tokens=6)
+    plain = make_engine("dense").generate(ps, opts)
+    eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=4, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(tp=2, pp=2, dp=2),
+    )
+    assert eng.generate(ps, opts) == plain
+
+
+def test_engine_pp_multi_step_decode_matches_solo():
+    """pp serving composes with decode_steps>1 (per-step pipelined scan)."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    ps = prompts(5, seed=12)
+    opts = SamplingOptions(max_new_tokens=7)
+    plain = make_engine("dense").generate(ps, opts)
+    eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=4, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32", decode_steps=4),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(pp=2, dp=2),
+    )
+    assert eng.generate(ps, opts) == plain
 
 
 def test_engine_ep_mesh_moe():

@@ -86,3 +86,111 @@ def test_rejects_mismatched_vocab():
     bp = llama.init_params(bad, jax.random.PRNGKey(1), jnp.float32)
     with pytest.raises(ValueError):
         SpeculativeDecoder(TARGET, tp, bad, bp)
+
+
+# -- engine-integrated speculative decoding -----------------------------------
+
+CFG = TARGET
+PARAMS = llama.init_params(TARGET, jax.random.PRNGKey(0), jnp.float32)
+DCFG = DRAFT
+DPARAMS = llama.init_params(DRAFT, jax.random.PRNGKey(7), jnp.float32)
+
+
+def _engine(draft=None, kind="dense", K=1, batch=4):
+    from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=batch, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32", speculative_k=3,
+                     decode_steps=K),
+        CacheConfig(kind=kind, page_size=8, num_pages=64,
+                    max_pages_per_session=8),
+        draft=draft,
+    )
+
+
+def _prompts(n, seed):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, size=int(rng.integers(3, 10))).tolist()
+            for _ in range(n)]
+
+
+@pytest.mark.parametrize("kind", ["dense", "paged"])
+def test_engine_speculative_matches_plain_greedy(kind):
+    """Speculative and normal sessions share a batch; all outputs equal the
+    non-speculative greedy engine's."""
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    ps = _prompts(6, 21)
+    plain = _engine(kind=kind).generate(ps, SamplingOptions(max_new_tokens=9))
+
+    eng = _engine(draft=(DCFG, DPARAMS), kind=kind)
+    subs = []
+    for i, p in enumerate(ps):
+        subs.append(eng._submit_session(
+            p, SamplingOptions(max_new_tokens=9, speculative=(i % 2 == 0))
+        ))
+    while eng.has_work():
+        eng.step()
+    assert [s.generated for s in subs] == plain
+    assert eng.spec_stats["steps"] > 0
+
+
+def test_engine_speculative_self_draft_full_acceptance():
+    """Draft == target: every proposal accepted (the catch-up path runs)."""
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    ps = _prompts(3, 22)
+    plain = _engine().generate(ps, SamplingOptions(max_new_tokens=8))
+    eng = _engine(draft=(CFG, PARAMS))
+    outs = eng.generate(
+        ps, SamplingOptions(max_new_tokens=8, speculative=True)
+    )
+    assert outs == plain
+    assert eng.spec_stats["accepted"] == eng.spec_stats["proposed"]
+
+
+def test_engine_speculative_requires_rollback_cache():
+    with pytest.raises(ValueError):
+        _engine(draft=(DCFG, DPARAMS), kind="sink")
+
+
+def test_engine_speculative_survives_capacity_disable_and_resume():
+    """Paged pool pressure disables speculation for some ticks (plain decode);
+    when pages free up and speculation resumes, the draft cache must have
+    been caught up — with draft == target, acceptance stays total. Without
+    the catch-up, the draft desyncs and acceptance collapses to ~0."""
+    from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    def mk(draft):
+        return InferenceEngine(
+            CFG, PARAMS,
+            EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                         max_seq_len=32, dtype="float32", speculative_k=3),
+            CacheConfig(kind="paged", page_size=4, num_pages=6,
+                        max_pages_per_session=8),
+            draft=draft,
+        )
+
+    pa, pb = [3, 14, 15, 9], [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5]
+    ref = mk(None)
+    ra = ref._submit_session(pa, SamplingOptions(max_new_tokens=8))
+    rb = ref._submit_session(pb, SamplingOptions(max_new_tokens=2))
+    while ref.has_work():
+        ref.step()
+
+    eng = mk((CFG, PARAMS))  # self-draft: every in-sync proposal accepted
+    sa = eng._submit_session(
+        pa, SamplingOptions(max_new_tokens=8, speculative=True)
+    )
+    sb = eng._submit_session(pb, SamplingOptions(max_new_tokens=2))
+    while eng.has_work():
+        eng.step()
+    assert (sa.generated, sb.generated) == (ra.generated, rb.generated)
+    st = eng.spec_stats
+    assert st["proposed"] > 0
+    assert st["accepted"] == st["proposed"], st
