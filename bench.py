@@ -271,6 +271,31 @@ def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32):
     return batch * steps / (time.perf_counter() - t0)
 
 
+class _PagedTTFTCache:
+    """Adapter so the TTFT bench prefills into a REAL paged cache (pages
+    pre-assigned) instead of silently reporting the dense-cache number for
+    the paged phase."""
+
+    @staticmethod
+    def create(num_layers, batch, max_len, num_kv_heads, head_dim,
+               dtype=jnp.bfloat16):
+        from distributed_llm_inference_tpu.cache.paged import (
+            PageAllocator,
+            PagedKVCache,
+        )
+
+        ps = 64
+        slots = -(-max_len // ps)
+        cache = PagedKVCache.create(
+            num_layers, batch, batch * slots + 1, ps, slots, num_kv_heads,
+            head_dim, dtype, use_kernel=jax.default_backend() == "tpu",
+        )
+        alloc = PageAllocator(batch * slots + 1)
+        for row in range(batch):
+            cache = cache.assign_pages(row, alloc.alloc(slots))
+        return cache
+
+
 # Weight config → (param builder, decode batch ladder, KV cache class).
 # Each phase runs in its own SUBPROCESS: the 7B-in-16GB fits are tight enough
 # that a prior phase's allocator state (fragmentation + anything an OOMed
@@ -318,7 +343,7 @@ def run_phase(name: str) -> dict:
                 err = repr(e)
         else:
             raise RuntimeError(f"all paged configs failed: {err}")
-        ttft = _ttft_bench(cfg, params)
+        ttft = _ttft_bench(cfg, params, cache_cls=_PagedTTFTCache)
     else:
         tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)
         ttft = _ttft_bench(cfg, params, cache_cls=cache_cls)

@@ -107,6 +107,7 @@ def cmd_serve(args) -> int:
         port, cfg, params["layers"], first, last, host=host,
         node_id=args.node_id, max_sessions=args.max_sessions,
         max_seq_len=args.max_seq_len, dtype=jnp.dtype(args.dtype),
+        quantize=args.quantize, kv_quant=args.kv_quant,
     )
     print(json.dumps({
         "event": "node_up", "node_id": node.node_id, "queue": node.queue,
@@ -273,6 +274,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--weights-cache", default=None,
                    help="directory for pre-converted weight caching "
                         "(skips HF-layout conversion on repeat bring-up)")
+    s.add_argument("--quantize", default=None, choices=("int8", "int4"),
+                   help="serve this block with quantized weights")
+    s.add_argument("--kv-quant", default=None, choices=("int8",),
+                   help="store this node's KV cache int8")
     s.set_defaults(fn=cmd_serve)
 
     g = sub.add_parser("generate", help="generate through registered nodes")

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..cache.base import window_ladder
-from ..cache.dense import DenseKVCache
+from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
 from ..config import ModelConfig
 from ..models import llama
 
@@ -45,22 +45,42 @@ class BlockBackend:
         max_seq_len: int = 512,
         dtype=jnp.bfloat16,
         session_idle_timeout: float = 60.0,
+        quantize: Optional[str] = None,
+        kv_quant: Optional[str] = None,
     ):
+        """``quantize`` ("int8"/"int4") serves the block with quantized
+        weights — the deployment-facing optimization the reference applied
+        on its serving node (bitsandbytes ``Linear8bitLt`` swap,
+        ``/root/reference/distributed_llm_inference/utils/model.py:93-123``);
+        ``kv_quant="int8"`` additionally stores this node's KV cache int8."""
         self.session_idle_timeout = session_idle_timeout
         self.cfg = cfg
+        if quantize in ("int8", "int4"):
+            from ..ops.quant import quantize_params
+
+            layer_params = quantize_params(
+                layer_params, bits=4 if quantize == "int4" else 8
+            )
+        elif quantize is not None:
+            raise ValueError(f"unknown quantize {quantize!r}")
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown kv_quant {kv_quant!r}")
         self.params = layer_params
         self.first_layer, self.last_layer = first_layer, last_layer
         self.num_block_layers = last_layer - first_layer + 1
         self.max_sessions = max_sessions
         self.max_seq_len = max_seq_len
         self.dtype = jnp.dtype(dtype)
+        self._cache_cls = (
+            QuantizedDenseKVCache if kv_quant == "int8" else DenseKVCache
+        )
 
         # Growth ladder (shared with the engine): the buffer starts at the
         # smallest bucket and zero-pad-grows as resident sessions lengthen,
         # so decode bandwidth tracks LIVE context; max_seq_len is the
         # virtual cap.
         self._windows = window_ladder(max_seq_len)
-        self.cache = DenseKVCache.create(
+        self.cache = self._cache_cls.create(
             self.num_block_layers, max_sessions, self._windows[0],
             cfg.num_kv_heads, cfg.head_dim, dtype,
         )
@@ -95,8 +115,8 @@ class BlockBackend:
         probe = jnp.zeros((1, 1, cfg.hidden_size), dtype)
         y, _ = self._row_step(
             self.params, probe,
-            DenseKVCache.create(self.num_block_layers, 1, 8, cfg.num_kv_heads,
-                                cfg.head_dim, dtype),
+            self._cache_cls.create(self.num_block_layers, 1, 8,
+                                   cfg.num_kv_heads, cfg.head_dim, dtype),
             jnp.int32(0), jnp.int32(1),
         )
         self.output_schema = {"shape_suffix": (cfg.hidden_size,),
@@ -136,7 +156,7 @@ class BlockBackend:
             slot = self.sessions.pop(lru)[0]
         if not self.sessions and self.cache.max_len > self._windows[0]:
             # Nothing resident: drop back to the smallest bucket (no copy).
-            self.cache = DenseKVCache.create(
+            self.cache = self._cache_cls.create(
                 self.num_block_layers, self.max_sessions, self._windows[0],
                 self.cfg.num_kv_heads, self.cfg.head_dim, self.dtype,
             )

@@ -14,6 +14,7 @@ of the reference's multi-tenant cache design (``models/llama/model.py:27`` →
 
 from __future__ import annotations
 
+import threading
 import time
 import uuid
 from typing import Dict, List, Optional, Sequence
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import ModelConfig
+from ..engine.sampling import SamplingOptions, SamplingParams, sample
 from ..models import llama
 from .directory import DirectoryClient
 from .messages import pack_frame, unpack_frame
@@ -67,8 +69,12 @@ class DistributedClient:
         self.dtype = jnp.dtype(dtype)
         self.prefill_buckets = tuple(prefill_buckets)
         self.host, self.relay_port = host, relay_port
-        self._relay = RelayClient(host, relay_port)
+        # The directory connection is shared across concurrent generations
+        # (its request/reply pairs must not interleave); relay connections
+        # are per-generation (each owns its reply queue), which is what
+        # makes N in-flight generations per client instance safe.
         self._directory = DirectoryClient(relay_port, host)
+        self._dir_lock = threading.Lock()
         self.failovers = 0  # mid-generation re-route count (observability)
 
         self._embed = jax.jit(
@@ -81,10 +87,18 @@ class DistributedClient:
 
         self._head_last = jax.jit(_head_last)
 
+        def _sample_last(params, x, idx, key, sp):
+            last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
+            logits = llama.apply_head(self.cfg, params, last)
+            return sample(logits[:, 0], key, sp)
+
+        self._sample_last = jax.jit(_sample_last)
+
     # -- routing --------------------------------------------------------------
 
     def plan_route(self) -> List[dict]:
-        return self._directory.route(self.cfg.num_layers)
+        with self._dir_lock:
+            return self._directory.route(self.cfg.num_layers)
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -95,15 +109,14 @@ class DistributedClient:
             f"{self.prefill_buckets[-1]}"
         )
 
-    def _send_through(self, route, gen_id: str, x: np.ndarray, num_new: int,
-                      timeout: float, reply_queue: str,
+    def _send_through(self, relay, route, gen_id: str, x: np.ndarray,
+                      num_new: int, timeout: float, reply_queue: str,
                       new: bool = False) -> np.ndarray:
         hops = [n["queue"] for n in route[1:]] + [reply_queue]
         header = {"op": "forward", "gen_id": gen_id, "num_new": num_new,
                   "hops": hops, "new": new}
-        self._relay.put(route[0]["queue"], pack_frame(header, np.asarray(x)))
-        reply_header, y = unpack_frame(self._relay.get(reply_queue,
-                                                       timeout=timeout))
+        relay.put(route[0]["queue"], pack_frame(header, np.asarray(x)))
+        reply_header, y = unpack_frame(relay.get(reply_queue, timeout=timeout))
         if reply_header.get("op") == "error":
             msg = f"worker {reply_header.get('from')}: {reply_header['error']}"
             # Retryability keys on the machine-readable code (worker.py:
@@ -116,16 +129,17 @@ class DistributedClient:
             )
             raise WorkerError(msg, retryable=retryable)
         if reply_header.get("gen_id") != gen_id:
-            raise RuntimeError("out-of-order reply (concurrent use of one "
-                               "client instance is not supported)")
+            raise RuntimeError(
+                "out-of-order reply on a per-generation queue (protocol bug)"
+            )
         return y
 
-    def _end_session(self, route, gen_id: str) -> None:
+    def _end_session(self, relay, route, gen_id: str) -> None:
         """Best-effort: surviving nodes free the session's cache row; dead
         nodes/relays are ignored (their rows age out with the node)."""
         for node in route:
             try:
-                self._relay.put(node["queue"], pack_frame(
+                relay.put(node["queue"], pack_frame(
                     {"op": "end", "gen_id": gen_id}
                 ))
             except Exception:
@@ -139,7 +153,10 @@ class DistributedClient:
             try:
                 self.plan_route()
                 return
-            except LookupError:
+            except (LookupError, TimeoutError, ConnectionError, OSError,
+                    RuntimeError):
+                # Coverage gap, or the directory/relay itself is still down
+                # (control-plane restart) — keep polling until the deadline.
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.25)
@@ -154,26 +171,44 @@ class DistributedClient:
         timeout: float = 60.0,
         max_retries: int = 2,
         reroute_wait: float = 15.0,
+        options: Optional[SamplingOptions] = None,
+        seed: int = 0,
     ) -> List[int]:
-        """Greedy decode of one prompt through the remote chain.
+        """Decode one prompt through the remote chain. Thread-safe: each
+        call owns its relay connection and reply queue, so N generations may
+        run concurrently on one client instance (the multi-tenant sessions
+        then co-batch on the serving nodes' task pools).
+
+        ``options`` carries sampling controls (temperature/top-k/top-p —
+        sampling happens client-side, where the head lives); default greedy.
+        ``seed`` keys the sampling stream: same seed, same tokens.
 
         Mid-generation failover (SURVEY §5.3): if a hop dies (reply timeout /
         worker error), the client waits for the directory to route around the
         loss, then REPLAYS the session on the new chain — re-prefilling
-        ``prompt + tokens so far`` under a fresh ``generation_id`` (greedy
-        decoding is deterministic, so the replayed stream continues exactly;
-        inference needs no optimizer state — recovery is reload + replay).
+        ``prompt + tokens so far`` under a fresh ``generation_id`` (the
+        replayed prefix is preserved verbatim; the continuation resumes the
+        same keyed sampling stream).
         """
         if not len(prompt):
             raise ValueError("empty prompt")
+        opts = options or SamplingOptions()
+        if eos_token_id is None and opts.eos_token_id >= 0:
+            eos_token_id = opts.eos_token_id
         out: List[int] = []
         failures = 0
+        key = jax.random.PRNGKey(seed)
         while True:
+            relay = RelayClient(self.host, self.relay_port)
             try:
                 return self._generate_attempt(
-                    list(prompt), out, max_new_tokens, eos_token_id, timeout
+                    relay, list(prompt), out, max_new_tokens, eos_token_id,
+                    timeout, opts, key,
                 )
-            except (TimeoutError, RuntimeError) as e:
+            except (TimeoutError, RuntimeError, ConnectionError, OSError) as e:
+                # Besides timeouts and worker errors, a relay/control-plane
+                # restart surfaces as a connection error mid-hop — that is a
+                # failover, not a client failure.
                 if isinstance(e, WorkerError) and not e.retryable:
                     raise  # deterministic worker error: replay cannot help
                 failures += 1
@@ -181,8 +216,11 @@ class DistributedClient:
                 if failures > max_retries:
                     raise
                 self._await_route(time.monotonic() + reroute_wait)
+            finally:
+                relay.close()
 
-    def _prefill_chunks(self, route, gen_id, tokens, timeout, reply_queue):
+    def _prefill_chunks(self, relay, route, gen_id, tokens, timeout,
+                        reply_queue):
         """Push ``tokens`` through the chain in bucket-sized chunks (the
         first with ``new=True``); returns ``(last chunk's hidden states,
         index of the last valid position in that chunk)``."""
@@ -195,13 +233,29 @@ class DistributedClient:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = np.asarray(chunk, np.int32)
             x = self._embed(self.params["embed"], jnp.asarray(padded))
-            y = self._send_through(route, gen_id, np.asarray(x), n, timeout,
-                                   reply_queue, new=(ci == 0))
+            y = self._send_through(relay, route, gen_id, np.asarray(x), n,
+                                   timeout, reply_queue, new=(ci == 0))
             last_n = n
         return y, last_n
 
+    def _next_token(self, y, idx, opts, key, step):
+        """Sample the next token from hidden states ``y`` at position
+        ``idx`` (client-side head). Greedy rows bypass the RNG entirely."""
+        if opts.temperature <= 0.0:
+            logits = self._head_last(self.params, jnp.asarray(y), idx)
+            return int(jnp.argmax(logits[0, -1]))
+        sp = SamplingParams.create(
+            1, opts.temperature, opts.top_k, opts.top_p
+        )
+        tok = self._sample_last(
+            self.params, jnp.asarray(y), idx,
+            jax.random.fold_in(key, step), sp,
+        )
+        return int(tok[0])
+
     def _generate_attempt(
-        self, prompt, out: List[int], max_new_tokens, eos_token_id, timeout
+        self, relay, prompt, out: List[int], max_new_tokens, eos_token_id,
+        timeout, opts, key,
     ) -> List[int]:
         """One route's worth of progress; ``out`` persists across attempts."""
         if out and (len(out) >= max_new_tokens or out[-1] == eos_token_id):
@@ -218,30 +272,29 @@ class DistributedClient:
             # bucket (long generation before the failure) still fits.
             replay = prompt + out[:-1]
             y, last_n = self._prefill_chunks(
-                route, gen_id, replay, timeout, reply_queue
+                relay, route, gen_id, replay, timeout, reply_queue
             )
             if out:
                 token = out[-1]
             else:
-                logits = self._head_last(self.params, jnp.asarray(y), last_n - 1)
-                token = int(jnp.argmax(logits[0, -1]))
+                token = self._next_token(y, last_n - 1, opts, key, 0)
                 out.append(token)
-            # Decode loop: one hidden-state hop per token.
+            # Decode loop: one hidden-state hop per token. The sampling key
+            # folds in the token INDEX, so a replayed attempt continues the
+            # same stream rather than restarting it.
             while len(out) < max_new_tokens and token != eos_token_id:
                 x = self._embed(
                     self.params["embed"], jnp.asarray([[token]], jnp.int32)
                 )
-                y = self._send_through(route, gen_id, np.asarray(x), 1,
-                                       timeout, reply_queue)
-                logits = self._head_last(self.params, jnp.asarray(y), 0)
-                token = int(jnp.argmax(logits[0, -1]))
+                y = self._send_through(relay, route, gen_id, np.asarray(x),
+                                       1, timeout, reply_queue)
+                token = self._next_token(y, 0, opts, key, len(out))
                 out.append(token)
             return out
         finally:
-            self._end_session(route, gen_id)
+            self._end_session(relay, route, gen_id)
 
     def close(self) -> None:
-        self._relay.close()
         self._directory.close()
 
     def __enter__(self):

@@ -112,6 +112,18 @@ class RelayClient:
         self._sock = socket.create_connection((self.host, self.port))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
+    def _reconnect(self) -> None:
+        """Drop the (dead) connection and dial again — the transparent
+        retry-once path for control-plane restarts (SURVEY §5.3: a relay
+        restart must not permanently wedge long-lived clients like the
+        worker's reply connection or the directory handle)."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._connect()
+
     def close(self) -> None:
         if self._sock is not None:
             self._sock.close()
@@ -135,7 +147,19 @@ class RelayClient:
         header = struct.pack(">BH", OP_PUT, len(q)) + q + struct.pack(
             ">Q", len(payload)
         )
-        self._sock.sendall(header + payload)
+        try:
+            self._sock.sendall(header + payload)
+        except (ConnectionError, OSError):
+            # Reconnect so the NEXT op runs on a live connection, but do NOT
+            # resend: the hub may have fully received the frame before the
+            # connection died, and an at-least-once PUT would double-apply a
+            # decode hop (the worker advances its cache twice and the stale
+            # duplicate reply silently corrupts the client's token stream).
+            # Callers treat the raise as a lost frame: workers drop the
+            # reply (the client times out and replays), clients fail over
+            # with a fresh generation_id.
+            self._reconnect()
+            raise
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -149,6 +173,15 @@ class RelayClient:
 
     def get(self, queue: str, timeout: Optional[float] = None) -> bytes:
         self._require_open()
+        try:
+            return self._get_once(queue, timeout)
+        except TimeoutError:
+            raise  # a timed-out GET is not a broken connection
+        except (ConnectionError, OSError):
+            self._reconnect()
+            return self._get_once(queue, timeout)
+
+    def _get_once(self, queue: str, timeout: Optional[float]) -> bytes:
         q = queue.encode()
         self._sock.sendall(struct.pack(">BH", OP_GET, len(q)) + q)
         # Timeout applies only to the FIRST byte: once the hub has started a

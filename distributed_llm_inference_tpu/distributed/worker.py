@@ -65,6 +65,8 @@ class ServingNode:
         lease_ttl: float = 10.0,
         dtype=None,
         batch_window_s: float = 0.002,
+        quantize=None,
+        kv_quant=None,
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.queue = f"block.{self.node_id}"
@@ -73,7 +75,7 @@ class ServingNode:
         kw = {} if dtype is None else {"dtype": dtype}
         self.backend = BlockBackend(
             cfg, layer_params, first_layer, last_layer, max_sessions,
-            max_seq_len, **kw,
+            max_seq_len, quantize=quantize, kv_quant=kv_quant, **kw,
         )
         self._stop = threading.Event()
         self.errors: List[str] = []

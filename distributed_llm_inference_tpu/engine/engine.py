@@ -90,11 +90,12 @@ class InferenceEngine:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics = Metrics()
         self.spans = SpanRecorder()
-        # Scheduler lock (SURVEY §5.2): submit()/cancel() may be called from
-        # request-handler threads while a server loop runs step(); all
-        # scheduler state (slots, waiting, sessions, cache, allocator) is
-        # mutated only under this lock. Public methods never call each other
-        # while holding it.
+        # Scheduler lock (SURVEY §5.2): slots/cache/allocator are mutated
+        # only by step()/collect_finished() under this lock (single-writer).
+        # submit()/cancel() are deliberately LOCK-FREE — step() holds the
+        # lock across whole device steps, and request admission/cancellation
+        # must not stall on that; they rely on GIL-atomic deque/dict ops and
+        # state flags the scheduler observes at tick boundaries.
         self._lock = threading.Lock()
 
         self.batch = self.ecfg.max_batch_size
@@ -469,25 +470,30 @@ class InferenceEngine:
         return self._submit_session(prompt, options).generation_id
 
     def _submit_session(self, prompt, options) -> Session:
+        # Lock-free on purpose: step() holds the scheduler lock across whole
+        # device steps (hundreds of ms at 7B shapes), and request-handler
+        # threads must not stall on it. deque.append and dict insertion are
+        # GIL-atomic; the scheduler only observes the session at its next
+        # admission pass.
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         s = Session(prompt=list(prompt), options=options or SamplingOptions())
-        with self._lock:
-            self.sessions[s.generation_id] = s
-            self.waiting.append(s)
+        self.sessions[s.generation_id] = s
+        self.waiting.append(s)
         self.metrics.counter("sessions_submitted")
         return s
 
     def cancel(self, generation_id: str) -> None:
-        """Thread-safe."""
-        with self._lock:
-            s = self.sessions.get(generation_id)
-            if s is None or s.state == SessionState.FINISHED:
-                return
-            s.state = SessionState.CANCELLED
-            s.finish_reason = "cancelled"
-            if s.slot is not None:
-                self._release(s)
+        """Thread-safe and non-blocking: marks the session; the scheduler
+        reaps it at the next tick boundary (releasing the slot needs the
+        scheduler lock, which step() holds across device steps — the
+        coarse-grained locking is the accepted tradeoff that keeps all
+        cache/slot state single-writer)."""
+        s = self.sessions.get(generation_id)
+        if s is None or s.state == SessionState.FINISHED:
+            return
+        s.state = SessionState.CANCELLED
+        s.finish_reason = "cancelled"
 
     def step(self) -> List[Tuple[str, int, bool]]:
         """One scheduler tick: admit + decode. Returns
@@ -526,9 +532,11 @@ class InferenceEngine:
         via ``step()`` must collect periodically or host memory grows with
         total requests served."""
         with self._lock:
+            # list(): submit() inserts into the dict lock-free; a snapshot
+            # keeps concurrent submission from breaking this iteration.
             done = {
                 gid: s
-                for gid, s in self.sessions.items()
+                for gid, s in list(self.sessions.items())
                 if s.state in (SessionState.FINISHED, SessionState.CANCELLED)
                 and s.slot is None
             }
@@ -600,6 +608,14 @@ class InferenceEngine:
             self._reshard_cache()
 
     def _admit(self, produced) -> None:
+        # Reap sessions cancelled since the last tick (cancel() is
+        # non-blocking and only marks state).
+        for slot, gid in enumerate(self.slots):
+            if gid is None:
+                continue
+            s = self.sessions[gid]
+            if s.state == SessionState.CANCELLED and s.slot is not None:
+                self._release(s)
         self._shrink_if_idle()
         for slot in range(self.batch):
             if self.slots[slot] is not None or not self.waiting:
@@ -989,6 +1005,8 @@ class InferenceEngine:
             )
 
     def _deliver(self, s: Session, token: int, produced) -> None:
+        if s.state == SessionState.CANCELLED:
+            return  # cancelled mid-step; the scheduler reaps the slot next tick
         s.record_token(token)
         done_eos = token == s.options.eos_token_id
         done_len = len(s.generated) >= s.options.max_new_tokens

@@ -151,7 +151,15 @@ def _decoder_layer(
 
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
     if cfg.num_experts > 0:
-        mlp = moe_mlp(cfg, p, h2)
+        # Bucket-padding positions (>= num_new) must not consume expert
+        # capacity in the dispatched prefill path.
+        valid = None
+        if s > 1:
+            valid = (
+                jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+                < num_new[:, None]
+            )
+        mlp = moe_mlp(cfg, p, h2, valid=valid)
     else:
         mlp = qmatmul(jax.nn.silu(qmatmul(h2, p["wg"])) * qmatmul(h2, p["wu"]), p["wd"])
     return x + mlp, new_state
