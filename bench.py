@@ -231,44 +231,77 @@ def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
     raise RuntimeError(f"all decode configs failed: {err}")
 
 
-def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32):
-    """Per-token decode over the paged pool with the Pallas paged-attention
-    kernel reading pages in place (the long-fragmented-context serving
-    configuration; no write-behind tail — pages are the anti-padding
-    mechanism)."""
-    from distributed_llm_inference_tpu.cache.paged import (
-        PageAllocator,
-        PagedKVCache,
+def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16):
+    """Decode over the paged pool with the Pallas paged-attention kernel
+    reading pages in place (the long-fragmented-context serving
+    configuration). ``scan_k > 1`` runs the fused write-behind-tail path
+    (pool read-only through K steps, pool-segment + tail joint softmax)."""
+    cache = _make_paged_cache(
+        cfg.num_layers, batch, min(ctx, ctx // 2 + steps), cfg.num_kv_heads,
+        cfg.head_dim,
     )
-
-    ps = 64
-    buf = min(ctx, ctx // 2 + steps)
-    slots = -(-buf // ps)
-    num_pages = batch * slots + 1
-    cache = PagedKVCache.create(
-        cfg.num_layers, batch, num_pages, ps, slots, cfg.num_kv_heads,
-        cfg.head_dim, use_kernel=jax.default_backend() == "tpu",
-    )
-    alloc = PageAllocator(num_pages)
-    for row in range(batch):
-        cache = cache.assign_pages(row, alloc.alloc(slots))
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     num_new = jnp.ones((batch,), jnp.int32)
     donate = {"donate_argnums": (2,)} if jax.default_backend() == "tpu" else {}
 
-    def decode(params, tokens, cache):
-        logits, cache = llama.model_apply(cfg, params, tokens, cache, num_new)
-        return jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None], cache
+    if scan_k > 1 and cache.use_kernel:
+        active = jnp.ones((batch,), bool)
+
+        def decode(params, tokens, cache):
+            def step_fn(i, logits, alive):
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return nxt, alive.astype(jnp.int32), alive, nxt
+
+            emits, cache = llama.multi_decode_apply(
+                cfg, params, tokens, cache, scan_k, step_fn, active,
+                active.astype(jnp.int32),
+            )
+            return emits[-1][:, None], cache
+
+        per_call = scan_k
+    else:
+        def decode(params, tokens, cache):
+            logits, cache = llama.model_apply(
+                cfg, params, tokens, cache, num_new
+            )
+            return (
+                jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None],
+                cache,
+            )
+
+        per_call = 1
 
     decode = jax.jit(decode, **donate)
     tokens = jnp.zeros((batch, 1), jnp.int32)
     tokens, cache = decode(params, tokens, cache)
     jax.block_until_ready(tokens)
+    calls = max(1, steps // per_call)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(calls):
         tokens, cache = decode(params, tokens, cache)
     jax.block_until_ready(tokens)
-    return batch * steps / (time.perf_counter() - t0)
+    return batch * calls * per_call / (time.perf_counter() - t0)
+
+
+def _make_paged_cache(num_layers, batch, max_len, num_kv_heads, head_dim,
+                      dtype=jnp.bfloat16, page_size=64):
+    """Paged pool sized for ``max_len`` tokens per row, every row's pages
+    pre-assigned (the single bring-up recipe for both the decode and TTFT
+    paged phases)."""
+    from distributed_llm_inference_tpu.cache.paged import (
+        PageAllocator,
+        PagedKVCache,
+    )
+
+    slots = -(-max_len // page_size)
+    cache = PagedKVCache.create(
+        num_layers, batch, batch * slots + 1, page_size, slots, num_kv_heads,
+        head_dim, dtype, use_kernel=jax.default_backend() == "tpu",
+    )
+    alloc = PageAllocator(batch * slots + 1)
+    for row in range(batch):
+        cache = cache.assign_pages(row, alloc.alloc(slots))
+    return cache
 
 
 class _PagedTTFTCache:
@@ -276,24 +309,7 @@ class _PagedTTFTCache:
     pre-assigned) instead of silently reporting the dense-cache number for
     the paged phase."""
 
-    @staticmethod
-    def create(num_layers, batch, max_len, num_kv_heads, head_dim,
-               dtype=jnp.bfloat16):
-        from distributed_llm_inference_tpu.cache.paged import (
-            PageAllocator,
-            PagedKVCache,
-        )
-
-        ps = 64
-        slots = -(-max_len // ps)
-        cache = PagedKVCache.create(
-            num_layers, batch, batch * slots + 1, ps, slots, num_kv_heads,
-            head_dim, dtype, use_kernel=jax.default_backend() == "tpu",
-        )
-        alloc = PageAllocator(batch * slots + 1)
-        for row in range(batch):
-            cache = cache.assign_pages(row, alloc.alloc(slots))
-        return cache
+    create = staticmethod(_make_paged_cache)
 
 
 # Weight config → (param builder, decode batch ladder, KV cache class).
@@ -335,12 +351,18 @@ def run_phase(name: str) -> dict:
     jax.block_until_ready(params)
     if cache_cls == "paged":
         err = None
+        tok_s = None
         for batch, ctx in ladder:
-            try:
-                tok_s = _try_paged_decode_bench(cfg, params, batch, ctx)
+            for scan_k in (16, 1):
+                try:
+                    tok_s = _try_paged_decode_bench(
+                        cfg, params, batch, ctx, scan_k=scan_k
+                    )
+                    break
+                except Exception as e:
+                    err = repr(e)
+            if tok_s is not None:
                 break
-            except Exception as e:
-                err = repr(e)
         else:
             raise RuntimeError(f"all paged configs failed: {err}")
         ttft = _ttft_bench(cfg, params, cache_cls=_PagedTTFTCache)

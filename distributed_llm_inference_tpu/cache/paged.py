@@ -203,6 +203,74 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         )
         return out, (new_k, new_v)
 
+    # -- write-behind tail (fused multi-step decode) --------------------------
+    #
+    # Kernel-only: the XLA fallback's per-step page gather is exactly the
+    # materialization the tail exists to avoid, so the engine gates the tail
+    # path on use_kernel for this cache. The page POOL stays read-only
+    # through all K steps (it rides the layer scan as a sliced operand —
+    # the carry-slice version costs two full pool copies plus relayouts per
+    # layer per step, ~4x the kernel's own time at 7B shapes) and new
+    # tokens live in a small dense tail merged into pages once per K steps.
+
+    def tail_init(self, k_steps: int):
+        l = self.k_pages.shape[0]
+        b = self.page_table.shape[0]
+        hkv, d = self.k_pages.shape[2], self.k_pages.shape[4]
+        z = jnp.zeros((l, b, k_steps, hkv, d), self.k_pages.dtype)
+        return (z, z)
+
+    def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
+                    base_len, tail_len, step_idx, num_new, sliding_window,
+                    scale=None):
+        from ..ops.attention import merge_softmax_segments
+        from ..ops.paged_attention import paged_attention
+
+        pool_k, pool_v = big_state
+        tk, tv = tail_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        tk = jax.lax.dynamic_update_slice_in_dim(tk, k_rot, step_idx, axis=1)
+        tv = jax.lax.dynamic_update_slice_in_dim(tv, v_new, step_idx, axis=1)
+
+        q_pos = base_len + tail_len  # [B]
+        out_pool, m_pool, l_pool = paged_attention(
+            q_rot, pool_k, pool_v, self.page_table, base_len,
+            scale=scale, sliding_window=sliding_window,
+            q_positions=q_pos, return_stats=True,
+        )
+
+        kk = tk.shape[1]
+        tail_pos = base_len[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+        tail_valid = (
+            jnp.arange(kk, dtype=jnp.int32)[None, :]
+            < (tail_len + num_new)[:, None]
+        )
+        if sliding_window is not None:
+            tail_valid &= tail_pos > (q_pos[:, None] - sliding_window)
+        out = merge_softmax_segments(
+            q_rot, out_pool, m_pool, l_pool, tk, tv, tail_valid, scale
+        )
+        return out, (tk, tv)
+
+    def tail_flush(self, tail, tail_len):
+        """Merge the tail into the page pool: the prefill scatter path, once
+        per K fused steps, batched over layers via vmap."""
+        wk, wv = tail  # [L, B, K, Hkv, D]
+        kk = wk.shape[2]
+        q_pos = (
+            self.lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+        )
+        num_new = tail_len
+        new_k, new_v = jax.vmap(
+            lambda lk, lv, tkl, tvl: self._scatter(
+                lk, lv, tkl, tvl, q_pos, num_new
+            )
+        )(self.k_pages, self.v_pages, wk, wv)
+        return self.replace(
+            k_pages=new_k, v_pages=new_v, lengths=self.lengths + tail_len
+        )
+
     def update_and_gather(
         self,
         layer_state: Tuple[jnp.ndarray, ...],

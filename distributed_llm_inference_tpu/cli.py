@@ -191,6 +191,7 @@ def cmd_local(args) -> int:
             max_new_tokens=args.max_new, dtype=args.dtype,
             quantization=args.quantize or ("int8" if args.int8 else None),
             speculative_k=args.speculative_k if draft else 0,
+            decode_steps=args.decode_steps,
         ),
         CacheConfig(kind=args.cache),
         draft=draft,
@@ -312,6 +313,9 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--dtype", default="bfloat16")
     l.add_argument("--weights-cache", default=None,
                    help="directory for pre-converted weight caching")
+    l.add_argument("--decode-steps", type=int, default=1,
+                   help="fused decode steps per dispatch (tokens stream "
+                        "every K steps; big throughput win on TPU)")
     l.add_argument("--speculative-draft", default=None,
                    help="draft model checkpoint dir: greedy speculative "
                         "decoding (same tokenizer/vocab as --model)")

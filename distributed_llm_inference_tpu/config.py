@@ -67,6 +67,12 @@ class ModelConfig:
     # MoE (Mixtral): 0 experts = dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Opt-in sorted expert dispatch for MoE prefill (ops/moe.py): tokens
+    # past an expert's capacity (N·k/E · this factor) are dropped, trading
+    # exactness for E/(k·factor)× less prefill compute. None (default)
+    # keeps the exact dense-combine path everywhere — drops would also make
+    # chunked prefill depend on chunk boundaries.
+    moe_capacity_factor: Optional[float] = None
     # Model family tag ("llama", "mistral", "qwen2", "mixtral").
     family: str = "llama"
 

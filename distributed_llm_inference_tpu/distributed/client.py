@@ -199,8 +199,12 @@ class DistributedClient:
         failures = 0
         key = jax.random.PRNGKey(seed)
         while True:
-            relay = RelayClient(self.host, self.relay_port)
+            relay = None
             try:
+                # Inside the try: a relay outage at attempt start (the
+                # control-plane-restart case) must count as a retried
+                # failover, not escape to the caller.
+                relay = RelayClient(self.host, self.relay_port)
                 return self._generate_attempt(
                     relay, list(prompt), out, max_new_tokens, eos_token_id,
                     timeout, opts, key,
@@ -217,7 +221,8 @@ class DistributedClient:
                     raise
                 self._await_route(time.monotonic() + reroute_wait)
             finally:
-                relay.close()
+                if relay is not None:
+                    relay.close()
 
     def _prefill_chunks(self, relay, route, gen_id, tokens, timeout,
                         reply_queue):

@@ -277,11 +277,19 @@ class InferenceEngine:
         K = self.ecfg.decode_steps
         # The write-behind tail composes with tp/ep/dp sharding (its scalar
         # slot writes and flush gather partition) but not with the staged
-        # pipeline program, which pp engines use per step instead.
+        # pipeline program, which pp engines use per step instead. The paged
+        # cache's tail path requires the Pallas kernel (the XLA fallback's
+        # per-step page gather is the materialization the tail avoids).
         tail_capable = (
             attention is None
             and not self._use_pp
-            and isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
+            and (
+                isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
+                or (
+                    isinstance(self.cache, PagedKVCache)
+                    and self.cache.use_kernel
+                )
+            )
         )
 
         def _decode_scan(params, tokens, cache, active, key, sp, eos_ids, budget):
@@ -484,16 +492,15 @@ class InferenceEngine:
         return s
 
     def cancel(self, generation_id: str) -> None:
-        """Thread-safe and non-blocking: marks the session; the scheduler
-        reaps it at the next tick boundary (releasing the slot needs the
-        scheduler lock, which step() holds across device steps — the
-        coarse-grained locking is the accepted tradeoff that keeps all
-        cache/slot state single-writer)."""
+        """Thread-safe and non-blocking: sets a monotonic flag; the
+        scheduler converts it to the CANCELLED state at the next tick
+        boundary (state transitions stay single-writer — a direct state
+        write here could race the scheduler's own WAITING→ACTIVE transition
+        mid-admission and be silently stomped)."""
         s = self.sessions.get(generation_id)
         if s is None or s.state == SessionState.FINISHED:
             return
-        s.state = SessionState.CANCELLED
-        s.finish_reason = "cancelled"
+        s.cancel_requested = True
 
     def step(self) -> List[Tuple[str, int, bool]]:
         """One scheduler tick: admit + decode. Returns
@@ -609,21 +616,29 @@ class InferenceEngine:
 
     def _admit(self, produced) -> None:
         # Reap sessions cancelled since the last tick (cancel() is
-        # non-blocking and only marks state).
+        # non-blocking and only sets the flag).
         for slot, gid in enumerate(self.slots):
             if gid is None:
                 continue
             s = self.sessions[gid]
-            if s.state == SessionState.CANCELLED and s.slot is not None:
+            if s.cancel_requested and s.slot is not None:
+                s.state = SessionState.CANCELLED
+                s.finish_reason = "cancelled"
                 self._release(s)
         self._shrink_if_idle()
         for slot in range(self.batch):
-            if self.slots[slot] is not None or not self.waiting:
+            if self.slots[slot] is not None:
+                continue
+            # Drain cancelled entries at the queue head WITHOUT advancing
+            # past this free slot — a real session behind them must not wait
+            # an extra tick per cancelled entry.
+            while self.waiting and self.waiting[0].cancel_requested:
+                dropped = self.waiting.popleft()
+                dropped.state = SessionState.CANCELLED
+                dropped.finish_reason = "cancelled"
+            if not self.waiting:
                 continue
             s = self.waiting[0]
-            if s.state == SessionState.CANCELLED:
-                self.waiting.popleft()
-                continue
             if not self._capacity_ok(s):
                 self.waiting.popleft()
                 self._finish(s, "capacity", produced)
@@ -1005,7 +1020,7 @@ class InferenceEngine:
             )
 
     def _deliver(self, s: Session, token: int, produced) -> None:
-        if s.state == SessionState.CANCELLED:
+        if s.cancel_requested or s.state == SessionState.CANCELLED:
             return  # cancelled mid-step; the scheduler reaps the slot next tick
         s.record_token(token)
         done_eos = token == s.options.eos_token_id

@@ -35,6 +35,11 @@ class Session:
         default_factory=lambda: f"gen-{next(_ids)}"
     )
     state: SessionState = SessionState.WAITING
+    # Set (only ever False→True) by cancel() from any thread; the scheduler
+    # converts it to the CANCELLED state at tick boundaries. A plain state
+    # write from cancel() could be stomped by the scheduler's own
+    # WAITING→ACTIVE transition mid-admission.
+    cancel_requested: bool = False
     slot: Optional[int] = None
     pages: List[int] = dataclasses.field(default_factory=list)
     generated: List[int] = dataclasses.field(default_factory=list)

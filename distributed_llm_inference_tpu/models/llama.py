@@ -311,9 +311,12 @@ def multi_decode_apply(
     steps (a finished row stays finished) so each row's tail slots stay
     contiguous. Returns ``(emits stacked [K, ...], cache flushed+advanced)``.
 
-    Only the dense cache kinds implement the tail protocol
-    (``tail_init`` / ``tail_attend`` / ``tail_flush``); callers fall back to
-    per-step ``model_apply`` for other caches.
+    The dense cache kinds implement the tail protocol
+    (``tail_init`` / ``tail_attend`` / ``tail_flush``) natively, and
+    ``PagedKVCache`` implements it over its page pool (kernel-gated: the
+    pool segment runs the Pallas paged kernel with exported softmax stats,
+    joint-merged with the tail — see ``cache/paged.py``); callers fall back
+    to per-step ``model_apply`` for other caches.
     """
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     big_stacks = cache.layer_stacks

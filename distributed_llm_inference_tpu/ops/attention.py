@@ -260,3 +260,58 @@ def gqa_attention_quantized_segments(
         )
     denom = jnp.maximum(denom, 1e-20).transpose(0, 3, 1, 2, 4)
     return (out / denom).reshape(b, s, hq, d).astype(q.dtype)
+
+
+def merge_softmax_segments(
+    q: jnp.ndarray,
+    out_a: jnp.ndarray,
+    m_a: jnp.ndarray,
+    l_a: jnp.ndarray,
+    k_tail: jnp.ndarray,
+    v_tail: jnp.ndarray,
+    tail_valid: jnp.ndarray,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Joint softmax of a PRE-COMPUTED attention segment with a small tail.
+
+    ``out_a`` (``[B, 1, Hq, D]``, already normalized) with online-softmax
+    stats ``m_a``/``l_a`` (``[B, Hkv, G]``) comes from a kernel that swept
+    its own keys (the paged pool); the tail segment (``k_tail``/``v_tail``
+    ``[B, K, Hkv, D]`` time-major, ``tail_valid`` ``[B, K]``) holds the
+    fused decode steps' fresh tokens. Flash-attention-style merge: exact,
+    not an approximation.
+    """
+    b, s, hq, d = q.shape
+    hkv = k_tail.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    qg = q.reshape(b, s, hkv, g, d)
+
+    sc = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k_tail, preferred_element_type=jnp.float32
+    ) * scale                                            # [B, Hkv, G, 1, K]
+    mask = tail_valid[:, None, None, None, :]
+    sc = jnp.where(mask, sc, _NEG_INF)
+    m_t = jnp.max(sc, axis=-1)                           # [B, Hkv, G, 1]
+    w = jnp.where(mask, jnp.exp(sc - m_t[..., None]), 0.0)
+    l_t = jnp.sum(w, axis=-1)                            # [B, Hkv, G, 1]
+    pv_t = jnp.einsum(
+        "bkgst,btkd->bskgd", w.astype(v_tail.dtype), v_tail,
+        preferred_element_type=jnp.float32,
+    )                                                    # [B, 1, Hkv, G, D]
+    out_t = pv_t / jnp.maximum(l_t, 1e-20).reshape(b, 1, hkv, g, 1)
+
+    m_t = m_t[..., 0]
+    l_t = l_t[..., 0]
+    m = jnp.maximum(m_a, m_t)                            # [B, Hkv, G]
+    w_a = l_a * jnp.exp(m_a - m)
+    w_t = l_t * jnp.exp(m_t - m)
+    denom = jnp.maximum(w_a + w_t, 1e-20)
+    fa = (w_a / denom)[:, None, :, :, None]
+    ft = (w_t / denom)[:, None, :, :, None]
+    out = (
+        out_a.reshape(b, s, hkv, g, d).astype(jnp.float32) * fa
+        + out_t * ft
+    )
+    return out.reshape(b, s, hq, d).astype(q.dtype)

@@ -54,7 +54,6 @@ def moe_mlp(
     cfg: ModelConfig,
     p,
     x: jnp.ndarray,
-    capacity_factor: float = 2.0,
     valid=None,
 ) -> jnp.ndarray:
     """SwiGLU expert MLPs + weighted combine.
@@ -62,17 +61,18 @@ def moe_mlp(
     ``p["router"]``: ``[H, E]``; ``p["we_g"]``/``p["we_u"]``: ``[E, H, F]``;
     ``p["we_d"]``: ``[E, F, H]`` (E shardable over ``ep``, F over ``tp``).
 
-    Short steps (decode, speculative verify) use dense-combine: they are
-    bound by reading every expert's weights regardless, so skipping compute
-    buys nothing, all shapes stay static, and every token's output is
-    independent of co-batched rows. Prefill-scale steps (S >= 16) dispatch
-    (``moe_mlp_dispatch``): tokens are sorted to their experts so each
-    expert computes only its own tokens — E/(k·capacity_factor)× less MLP
-    compute (2× for Mixtral at factor 2). ``valid`` (``[B, S]`` bool) marks
-    real tokens; bucket-padding positions must not consume expert capacity.
+    Dense-combine is the default everywhere: exact, shape-static, and every
+    token's output independent of co-batched rows (decode and verify steps
+    are bound by reading every expert's weights regardless, so the
+    overcompute is free there). Setting ``ModelConfig.moe_capacity_factor``
+    OPTS IN to sorted dispatch for prefill-scale steps (S >= 16):
+    E/(k·factor)× less MLP compute at the cost of capacity drops — which
+    also make results depend on prefill chunk boundaries, hence opt-in.
+    ``valid`` (``[B, S]`` bool) marks real tokens; bucket-padding positions
+    must not consume expert capacity in the dispatched path.
     """
-    if x.shape[1] >= 16:
-        return moe_mlp_dispatch(cfg, p, x, capacity_factor, valid)
+    if cfg.moe_capacity_factor is not None and x.shape[1] >= 16:
+        return moe_mlp_dispatch(cfg, p, x, cfg.moe_capacity_factor, valid)
     combine = router_weights(cfg, x, p["router"]).astype(x.dtype)
     t = quant.einsum("bsh,ehf->bsef", x, p["we_g"])
     u = quant.einsum("bsh,ehf->bsef", x, p["we_u"])
@@ -115,6 +115,11 @@ def moe_mlp_dispatch(
     ``valid`` (``[B, S]`` bool): invalid (bucket-padding) tokens route to a
     sentinel expert id ``E`` — the stable sort parks them AFTER every real
     expert's group, so padding can never evict a real token from capacity.
+
+    NOTE: under an ``ep``-sharded mesh the expert-indexed gathers here have
+    not been perf-verified (GSPMD may all-gather the expert stacks); the
+    dense-combine path is the ep-proven one. Dispatch is opt-in
+    (``ModelConfig.moe_capacity_factor``) partly for this reason.
     """
     b, s, h = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok

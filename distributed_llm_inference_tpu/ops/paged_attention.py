@@ -24,7 +24,16 @@ Bandwidth properties:
 
 Online-softmax state (running max / denominator / accumulator) lives in VMEM
 scratch carried across the page-grid axis (innermost ⇒ scratch persists
-across one row's page sweep).
+across one row's page sweep). The per-row (m, l) stats are ALSO emitted so
+callers can merge this segment with others under one joint softmax — the
+write-behind-tail decode (``models/llama.py:multi_decode_apply``) combines
+the pool segment with the small tail segment that holds the fused steps' new
+tokens.
+
+``q_positions`` decouples the query's absolute position from the pool length:
+in the tail regime the query sits ``tail_len`` tokens PAST the pool contents
+(sliding-window masking needs the true position; plain causality over the
+pool is just slot validity either way).
 
 Runs in interpret mode off-TPU so the CPU test mesh exercises it.
 """
@@ -47,10 +56,13 @@ __all__ = ["paged_attention"]
 def _paged_kernel(
     table_ref,  # SMEM [B, T] int32 (scalar prefetch)
     len_ref,    # SMEM [B] int32 (scalar prefetch)
+    qpos_ref,   # SMEM [B] int32 (scalar prefetch): query's absolute position
     q_ref,      # [1, Hkv, G, D]
     k_ref,      # [1, Hkv, PS, D]
     v_ref,      # [1, Hkv, PS, D]
     out_ref,    # [1, Hkv, G, D]
+    m_out_ref,  # [1, Hkv*G, 128] f32
+    l_out_ref,  # [1, Hkv*G, 128] f32
     acc_ref,    # VMEM [Hkv*G, D] f32
     m_ref,      # VMEM [Hkv*G, 128] f32
     l_ref,      # VMEM [Hkv*G, 128] f32
@@ -73,14 +85,15 @@ def _paged_kernel(
 
     kv_len = len_ref[b]
 
-    # Live-kv + sliding-window mask for this page's slots. Decode query sits
-    # at position kv_len - 1, so causality ≡ slot validity.
+    # Live-kv mask for this page's slots (pool slots < kv_len precede the
+    # query, so causality ≡ slot validity); the sliding window is measured
+    # from the query's true position.
     pos = j * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (1, page_size), 1
     )
     valid = pos < kv_len
     if sliding_window is not None:
-        valid &= pos > kv_len - 1 - sliding_window
+        valid &= pos > qpos_ref[b] - sliding_window
 
     q = q_ref[0]  # [Hkv, G, D]
     k = k_ref[0]  # [Hkv, PS, D]
@@ -128,6 +141,8 @@ def _paged_kernel(
         l = l_ref[:, :1]
         out = acc_ref[:] / jnp.maximum(l, 1e-20)
         out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
 
 
 def paged_attention(
@@ -139,14 +154,20 @@ def paged_attention(
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
     interpret: Optional[bool] = None,
-) -> jnp.ndarray:
+    q_positions: Optional[jnp.ndarray] = None,
+    return_stats: bool = False,
+):
     """Decode attention straight over the page pool.
 
     ``q``: ``[B, 1, Hq, D]`` (already rotated); ``k_pages``/``v_pages``:
     ``[P, Hkv, page_size, D]`` — one layer's pool, keys stored rotated;
     ``page_table``: ``[B, T]`` int32 physical page ids (slot order = position
-    order, 0 = null page); ``kv_lengths``: ``[B]`` int32 live kv count per row
-    *including* the token written this step. Returns ``[B, 1, Hq, D]``.
+    order, 0 = null page); ``kv_lengths``: ``[B]`` int32 live kv count per
+    row; ``q_positions``: ``[B]`` absolute query positions (defaults to
+    ``kv_lengths - 1`` — the classic decode step attending to itself last).
+    Returns ``[B, 1, Hq, D]``, or with ``return_stats`` a tuple
+    ``(out, m, l)`` with ``m``/``l`` ``[B, Hkv, G]`` fp32 online-softmax
+    stats for joint-softmax merging with other segments.
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -158,25 +179,39 @@ def paged_attention(
         scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if q_positions is None:
+        q_positions = kv_lengths - 1
 
     qr = q.reshape(b, hkv, g, d)  # kv-head-major grouping, as gqa_attention
 
-    def _page_index(bi, ji, table, lens):
+    def _page_index(bi, ji, table, lens, qpos):
         # Clamp blocks past the row's live span to the null page: the fetch
         # still happens (BlockSpec semantics) but hits one hot page.
         live = ji * page_size < lens[bi]
         return (jnp.where(live, table[bi, ji], 0), 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, t),
         in_specs=[
-            pl.BlockSpec((1, hkv, g, d), lambda bi, ji, table, lens: (bi, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, hkv, g, d), lambda bi, ji, table, lens, qpos: (bi, 0, 0, 0)
+            ),
             pl.BlockSpec((1, hkv, page_size, d), _page_index),
             pl.BlockSpec((1, hkv, page_size, d), _page_index),
         ],
-        out_specs=pl.BlockSpec(
-            (1, hkv, g, d), lambda bi, ji, table, lens: (bi, 0, 0, 0)
+        out_specs=(
+            pl.BlockSpec(
+                (1, hkv, g, d), lambda bi, ji, table, lens, qpos: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, hkv * g, 128),
+                lambda bi, ji, table, lens, qpos: (bi, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, hkv * g, 128),
+                lambda bi, ji, table, lens, qpos: (bi, 0, 0),
+            ),
         ),
         scratch_shapes=[
             pltpu.VMEM((hkv * g, d), jnp.float32),
@@ -193,11 +228,18 @@ def paged_attention(
         hkv=hkv,
         g=g,
     )
-    out = pl.pallas_call(
+    out, m, l = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
+        ),
         grid_spec=grid_spec,
         interpret=interpret,
     )(page_table.astype(jnp.int32), kv_lengths.astype(jnp.int32),
-      qr, k_pages, v_pages)
-    return out.reshape(b, 1, hq, d)
+      q_positions.astype(jnp.int32), qr, k_pages, v_pages)
+    out = out.reshape(b, 1, hq, d)
+    if return_stats:
+        return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)
+    return out

@@ -493,3 +493,23 @@ def test_decode_steps_capacity_finish():
     s = list(eng.sessions.values())[0]
     assert s.finish_reason == "capacity"
     assert len(outs[0]) <= 64 - 58
+
+
+def test_cancel_active_session_frees_slot():
+    """Cancelling a running session releases its slot at the next tick and
+    admits queued work (cancel() is a flag; the scheduler owns state)."""
+    from distributed_llm_inference_tpu.engine.session import SessionState
+
+    eng = make_engine(batch=1)
+    a = eng.submit(prompts(1, seed=13)[0], SamplingOptions(max_new_tokens=50))
+    b = eng.submit(prompts(1, seed=14)[0], SamplingOptions(max_new_tokens=3))
+    for _ in range(3):
+        eng.step()  # a is active, b waits
+    assert eng.sessions[a].state == SessionState.ACTIVE
+    eng.cancel(a)
+    while eng.has_work():
+        eng.step()
+    assert eng.sessions[a].state == SessionState.CANCELLED
+    assert eng.sessions[a].finish_reason == "cancelled"
+    assert len(eng.sessions[a].generated) <= 5  # stopped promptly
+    assert len(eng.sessions[b].generated) == 3  # b got the slot and finished

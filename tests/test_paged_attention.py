@@ -140,3 +140,92 @@ def test_engine_with_kernel_matches_without():
         return eng.generate(reqs, SamplingOptions(max_new_tokens=8))
 
     assert run(False) == run(True)
+
+
+def test_paged_tail_engine_parity():
+    """Paged cache + kernel + fused K-step decode (pool read-only, tail
+    merged via joint softmax) reproduces plain per-token decoding."""
+    import numpy as np
+
+    from distributed_llm_inference_tpu.config import (
+        CacheConfig,
+        EngineConfig,
+        ModelConfig,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+    from distributed_llm_inference_tpu.models import llama
+
+    cfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=160,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(31)
+    ps_ = [rng.integers(0, 128, size=int(rng.integers(3, 12))).tolist()
+           for _ in range(5)]
+    opts = SamplingOptions(max_new_tokens=9)
+
+    def run(K, kernel):
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch_size=4, prefill_buckets=(8, 16, 32),
+                         max_seq_len=64, dtype="float32", decode_steps=K,
+                         use_pallas_attention=kernel),
+            CacheConfig(kind="paged", page_size=8, num_pages=64,
+                        max_pages_per_session=8),
+        )
+        return eng.generate(ps_, opts)
+
+    assert run(4, True) == run(1, False)
+
+
+def test_paged_kernel_stats_merge_oracle():
+    """paged_attention(return_stats=True) + merge_softmax_segments over a
+    tail == one full attention over pool∪tail."""
+    import numpy as np
+
+    from distributed_llm_inference_tpu.ops.attention import (
+        causal_mask,
+        gqa_attention,
+        merge_softmax_segments,
+    )
+
+    rng = np.random.default_rng(5)
+    B, HKV, G, D, PS, SLOTS, K = 3, 2, 2, 16, 8, 3, 5
+    HQ = HKV * G
+    pool_pages = SLOTS * B + 1
+    kp = jnp.asarray(rng.normal(size=(pool_pages, HKV, PS, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(pool_pages, HKV, PS, D)), jnp.float32)
+    table = jnp.asarray(
+        np.arange(1, B * SLOTS + 1).reshape(B, SLOTS), jnp.int32
+    )
+    base_len = jnp.asarray([13, 7, 0], jnp.int32)
+    tail_len = jnp.asarray([3, 2, 1], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, 1, HQ, D)), jnp.float32)
+    tk = jnp.asarray(rng.normal(size=(B, K, HKV, D)), jnp.float32)
+    tv = jnp.asarray(rng.normal(size=(B, K, HKV, D)), jnp.float32)
+    tail_valid = jnp.arange(K)[None, :] < tail_len[:, None]
+
+    from distributed_llm_inference_tpu.ops.paged_attention import paged_attention
+
+    out_pool, m, l = paged_attention(
+        q, kp, vp, table, base_len, q_positions=base_len + tail_len - 1,
+        return_stats=True,
+    )
+    merged = merge_softmax_segments(q, out_pool, m, l, tk, tv, tail_valid)
+
+    # Oracle: gather pool rows contiguous, concat tail, one dense attention.
+    T = SLOTS * PS
+    gk = kp[table].transpose(0, 1, 3, 2, 4).reshape(B, T, HKV, D)
+    gv = vp[table].transpose(0, 1, 3, 2, 4).reshape(B, T, HKV, D)
+    k_all = jnp.concatenate([gk, tk], axis=1)
+    v_all = jnp.concatenate([gv, tv], axis=1)
+    pos = jnp.arange(T + K)[None, :]
+    valid = jnp.where(
+        pos < T, pos < base_len[:, None],
+        (pos - T) < tail_len[:, None],
+    )
+    mask = valid[:, None, :]
+    ref = gqa_attention(q, k_all, v_all, mask)
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
