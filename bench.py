@@ -130,10 +130,14 @@ def _try_decode_bench(
     runs with ``EngineConfig.decode_steps``; ``scan_k=1`` is the per-token
     dispatch path.
     """
-    # Buffer sized to the bucket this workload reaches (ctx//2 live + the
-    # steps generated) — the serving engine's growth ladder does the same:
-    # decode bandwidth tracks live context, with ctx as the virtual cap.
-    buf = min(ctx, ctx // 2 + steps)
+    # Buffer sized to the bucket this workload reaches (ctx//2 live + every
+    # token the warmup AND timed calls write) — the serving engine's growth
+    # ladder does the same: decode bandwidth tracks live context, with ctx
+    # as the virtual cap. Under-sizing would silently clamp the last calls'
+    # writes and fake the measured traffic.
+    k = scan_k if scan_k > 1 else 1
+    writes = (max(1, steps // k) + 1) * k  # +1: the warmup call
+    buf = min(ctx, ctx // 2 + writes)
     cache = cache_cls.create(
         cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim
     )
@@ -213,32 +217,43 @@ def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
     executable usually still compiles there.
     """
     err = None
-    for batch, ctx in ladder:
-        for scan_k in (16, 1):
+    # Two independent descents — the fused K-step path and per-token
+    # dispatch — each stopping at its first batch that fits/compiles (some
+    # shapes OOM or crash the remote AOT compiler); report the better.
+    # Neither dominates: fused wins at large batch, but when only small
+    # fused batches compile, a larger per-token batch can still be faster.
+    best = None
+    for scan_k in (16, 1):
+        for batch, ctx in ladder:
             try:
-                return (
-                    _try_decode_bench(
-                        cfg, params, batch, ctx, cache_cls=cache_cls,
-                        scan_k=scan_k,
-                    ),
-                    batch,
+                tok_s = _try_decode_bench(
+                    cfg, params, batch, ctx, cache_cls=cache_cls,
+                    scan_k=scan_k,
                 )
             except Exception as e:
                 # repr, not the exception: a held traceback pins the failed
                 # attempt's device buffers and starves the next retry.
                 err = repr(e)
                 continue
-    raise RuntimeError(f"all decode configs failed: {err}")
+            if best is None or tok_s > best[0]:
+                best = (tok_s, batch)
+            break
+    if best is None:
+        raise RuntimeError(f"all decode configs failed: {err}")
+    return best
 
 
-def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16):
+def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16,
+                            cls=None):
     """Decode over the paged pool with the Pallas paged-attention kernel
     reading pages in place (the long-fragmented-context serving
     configuration). ``scan_k > 1`` runs the fused write-behind-tail path
     (pool read-only through K steps, pool-segment + tail joint softmax)."""
+    k = scan_k if scan_k > 1 else 1
+    writes = (max(1, steps // k) + 1) * k  # +1: the warmup call
     cache = _make_paged_cache(
-        cfg.num_layers, batch, min(ctx, ctx // 2 + steps), cfg.num_kv_heads,
-        cfg.head_dim,
+        cfg.num_layers, batch, min(ctx, ctx // 2 + writes), cfg.num_kv_heads,
+        cfg.head_dim, cls=cls,
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     num_new = jnp.ones((batch,), jnp.int32)
@@ -284,7 +299,7 @@ def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16):
 
 
 def _make_paged_cache(num_layers, batch, max_len, num_kv_heads, head_dim,
-                      dtype=jnp.bfloat16, page_size=64):
+                      dtype=jnp.bfloat16, page_size=64, cls=None):
     """Paged pool sized for ``max_len`` tokens per row, every row's pages
     pre-assigned (the single bring-up recipe for both the decode and TTFT
     paged phases)."""
@@ -293,8 +308,10 @@ def _make_paged_cache(num_layers, batch, max_len, num_kv_heads, head_dim,
         PagedKVCache,
     )
 
+    if cls is None:
+        cls = PagedKVCache
     slots = -(-max_len // page_size)
-    cache = PagedKVCache.create(
+    cache = cls.create(
         num_layers, batch, batch * slots + 1, page_size, slots, num_kv_heads,
         head_dim, dtype, use_kernel=jax.default_backend() == "tpu",
     )
@@ -340,6 +357,9 @@ PHASES = {
     # int8 weights + Pallas paged-attention kernel over the page pool.
     "paged_pallas": (_zero_qparams, ((48, 256), (32, 256), (16, 256)),
                      "paged"),
+    # ...and with int8 pages + scale planes (halved pool bytes buys batch).
+    "paged_kvq": (_zero_qparams, ((96, 256), (64, 256), (48, 256)),
+                  "paged_kvq"),
 }
 
 
@@ -349,22 +369,30 @@ def run_phase(name: str) -> dict:
     build, ladder, cache_cls = PHASES[name]
     params = build(cfg)
     jax.block_until_ready(params)
-    if cache_cls == "paged":
+    if cache_cls in ("paged", "paged_kvq"):
+        from distributed_llm_inference_tpu.cache.paged import (
+            PagedKVCache,
+            QuantizedPagedKVCache,
+        )
+
+        pcls = QuantizedPagedKVCache if cache_cls == "paged_kvq" else PagedKVCache
         err = None
-        tok_s = None
-        for batch, ctx in ladder:
-            for scan_k in (16, 1):
+        best = None
+        for scan_k in (16, 1):  # best of the two descents (see _decode_ladder)
+            for b_, ctx in ladder:
                 try:
-                    tok_s = _try_paged_decode_bench(
-                        cfg, params, batch, ctx, scan_k=scan_k
+                    t_ = _try_paged_decode_bench(
+                        cfg, params, b_, ctx, scan_k=scan_k, cls=pcls
                     )
-                    break
                 except Exception as e:
                     err = repr(e)
-            if tok_s is not None:
+                    continue
+                if best is None or t_ > best[0]:
+                    best = (t_, b_)
                 break
-        else:
+        if best is None:
             raise RuntimeError(f"all paged configs failed: {err}")
+        tok_s, batch = best
         ttft = _ttft_bench(cfg, params, cache_cls=_PagedTTFTCache)
     else:
         tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)

@@ -100,6 +100,29 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         scheduler must have mapped enough pages in ``page_table``."""
         return self.lengths + num_new <= self.max_len
 
+    def _slot_pages(self, q_pos: jnp.ndarray, num_new: jnp.ndarray):
+        """Map incoming tokens' absolute positions ``[B, S]`` →
+        ``(physical page, in-page offset)``, both ``[B, S]``.
+
+        Inactive rows / padding positions (``>= num_new``) and out-of-range
+        table slots divert to the NULL page 0 — an inactive slot's old pages
+        may already belong to ANOTHER session (freed + reallocated), so a
+        write there would corrupt it. Shared by the bf16 and int8 pool
+        scatters so the safety mapping cannot drift between them.
+        """
+        s = q_pos.shape[1]
+        table_slot = q_pos // self.page_size
+        offset = q_pos % self.page_size
+        in_range = (
+            jnp.arange(s, dtype=jnp.int32)[None, :] < num_new[:, None]
+        ) & (table_slot < self.page_table.shape[1])
+        phys = jnp.take_along_axis(
+            self.page_table,
+            jnp.minimum(table_slot, self.page_table.shape[1] - 1),
+            axis=1,
+        )
+        return jnp.where(in_range, phys, 0), offset
+
     def _scatter(
         self,
         layer_k: jnp.ndarray,
@@ -112,24 +135,14 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         """Scatter rotated k / raw v into the page pool at each incoming
         token's (physical page, offset) per the row's page table."""
         b, s, hkv, d = k_rot.shape
+        phys_page, offset_bs = self._slot_pages(q_pos, num_new)
         if s == 1:
             # Decode: one (page, offset) per row. A sequential per-row
             # dynamic_update_slice chain updates the donated pool in place;
             # the general scatter below costs ~2x a decode step at 7B shapes
             # (measured: XLA rewrites the pool).
-            table_slot = q_pos[:, 0] // self.page_size
-            offset = q_pos[:, 0] % self.page_size
-            # Inactive rows (num_new == 0) and out-of-range slots divert to
-            # the null page — an inactive slot's old pages may already belong
-            # to ANOTHER session (freed + reallocated), so a write there
-            # corrupts it.
-            in_range = (num_new > 0) & (table_slot < self.page_table.shape[1])
-            page = jnp.take_along_axis(
-                self.page_table,
-                jnp.minimum(table_slot, self.page_table.shape[1] - 1)[:, None],
-                axis=1,
-            )[:, 0]
-            page = jnp.where(in_range, page, 0)  # null page absorbs the write
+            page = phys_page[:, 0]
+            offset = offset_bs[:, 0]
 
             def body(r, bufs):
                 bk, bv = bufs
@@ -142,21 +155,8 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
                 )
 
             return jax.lax.fori_loop(0, b, body, (layer_k, layer_v))
-        # Map each incoming token's absolute position → (physical page, offset).
-        table_slot = q_pos // self.page_size  # [B, S]
-        offset = q_pos % self.page_size
-        in_range = (
-            jnp.arange(s, dtype=jnp.int32)[None, :] < num_new[:, None]
-        ) & (table_slot < self.page_table.shape[1])
-        phys_page = jnp.take_along_axis(
-            self.page_table, jnp.minimum(table_slot, self.page_table.shape[1] - 1),
-            axis=1,
-        )
-        # Padding / out-of-range tokens are routed to the null page 0.
-        phys_page = jnp.where(in_range, phys_page, 0)
-
         flat_page = phys_page.reshape(-1)
-        flat_off = offset.reshape(-1)
+        flat_off = offset_bs.reshape(-1)
         # Pool is [P, Hkv, PS, D]: advanced indices (page, offset) around the
         # head slice put the broadcast dim first → writes are [N, Hkv, D].
         new_k = layer_k.at[flat_page, :, flat_off].set(
@@ -468,3 +468,232 @@ class PageAllocator:
                 del self._refs[p]
                 self._free.append(p)
                 self._free_set.add(p)
+
+
+class QuantizedPagedKVCache(PagedKVCache):
+    """Page pool with int8 K/V + per-(slot, head) fp32 scale planes.
+
+    The paged counterpart of :class:`cache.dense.QuantizedDenseKVCache`:
+    decode reads every live page each step, so int8 pages halve the pool's
+    HBM traffic. Scales ride separate ``[L, P, Hkv, PS]`` planes (≈1.5%
+    byte overhead at D=128); the Pallas kernel dequantizes ON THE SCORES
+    (``q·(k·s) = s·(q·k)``) so the int8 pages stream through VMEM as-is,
+    and the XLA gather fallback dequantizes its contiguous view.
+    """
+
+    # Dataclass inheritance: fields after the parent's defaulted ones need
+    # defaults; create() always supplies real arrays.
+    ks_pages: jax.Array = None
+    vs_pages: jax.Array = None
+
+    BATCH_AXES = {"page_table": 0, "lengths": 0}
+    LAYER_FIELDS = ("k_pages", "v_pages", "ks_pages", "vs_pages")
+    SHARED_FIELDS = ("k_pages", "v_pages", "ks_pages", "vs_pages")
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        batch: int,
+        num_pages: int,
+        page_size: int,
+        max_pages_per_session: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,  # interface parity; values are int8
+        use_kernel: bool = False,
+    ) -> "QuantizedPagedKVCache":
+        shape = (num_layers, num_pages, num_kv_heads, page_size, head_dim)
+        return QuantizedPagedKVCache(
+            k_pages=jnp.zeros(shape, jnp.int8),
+            v_pages=jnp.zeros(shape, jnp.int8),
+            ks_pages=jnp.zeros(shape[:-1], jnp.float32),
+            vs_pages=jnp.zeros(shape[:-1], jnp.float32),
+            page_table=jnp.zeros((batch, max_pages_per_session), jnp.int32),
+            lengths=jnp.zeros((batch,), jnp.int32),
+            page_size=page_size,
+            use_kernel=use_kernel,
+        )
+
+    @property
+    def layer_stacks(self):
+        return (self.k_pages, self.v_pages, self.ks_pages, self.vs_pages)
+
+    def with_layer_stacks(self, k, v, ks, vs) -> "QuantizedPagedKVCache":
+        return self.replace(k_pages=k, v_pages=v, ks_pages=ks, vs_pages=vs)
+
+    def merge_row(self, sub, row) -> "QuantizedPagedKVCache":
+        return self.replace(
+            k_pages=sub.k_pages,
+            v_pages=sub.v_pages,
+            ks_pages=sub.ks_pages,
+            vs_pages=sub.vs_pages,
+            page_table=jax.lax.dynamic_update_slice_in_dim(
+                self.page_table, sub.page_table, row, axis=0
+            ),
+            lengths=jax.lax.dynamic_update_slice_in_dim(
+                self.lengths, sub.lengths, row, axis=0
+            ),
+        )
+
+    def _scatter_q(self, layer_k, layer_v, layer_ks, layer_vs, k_rot, v_new,
+                   q_pos, num_new):
+        """Quantize incoming k/v, then the :meth:`_scatter` write pattern
+        over the four planes."""
+        from .dense import _quantize_kv
+
+        b, s, hkv, d = k_rot.shape
+        k_q, k_s = _quantize_kv(k_rot)
+        v_q, v_s = _quantize_kv(v_new)
+        phys_page, offset_bs = self._slot_pages(q_pos, num_new)
+        if s == 1:
+            page = phys_page[:, 0]
+            offset = offset_bs[:, 0]
+
+            def body(r, bufs):
+                bk, bv, bks, bvs = bufs
+                kv = k_q[r, 0][:, None, :]
+                vv = v_q[r, 0][:, None, :]
+                ks1 = k_s[r, 0][:, None]
+                vs1 = v_s[r, 0][:, None]
+                start = (page[r], 0, offset[r], 0)
+                start3 = (page[r], 0, offset[r])
+                return (
+                    jax.lax.dynamic_update_slice(bk, kv[None], start),
+                    jax.lax.dynamic_update_slice(bv, vv[None], start),
+                    jax.lax.dynamic_update_slice(bks, ks1[None], start3),
+                    jax.lax.dynamic_update_slice(bvs, vs1[None], start3),
+                )
+
+            return jax.lax.fori_loop(
+                0, b, body, (layer_k, layer_v, layer_ks, layer_vs)
+            )
+        flat_page = phys_page.reshape(-1)
+        flat_off = offset_bs.reshape(-1)
+        return (
+            layer_k.at[flat_page, :, flat_off].set(
+                k_q.reshape(b * s, hkv, d), mode="drop"
+            ),
+            layer_v.at[flat_page, :, flat_off].set(
+                v_q.reshape(b * s, hkv, d), mode="drop"
+            ),
+            layer_ks.at[flat_page, :, flat_off].set(
+                k_s.reshape(b * s, hkv), mode="drop"
+            ),
+            layer_vs.at[flat_page, :, flat_off].set(
+                v_s.reshape(b * s, hkv), mode="drop"
+            ),
+        )
+
+    def attend(self, layer_state, q, k_new, v_new, rope, q_pos, num_new,
+               sliding_window, attention_fn, scale=None):
+        if not self.use_kernel or q.shape[1] != 1:
+            return super(PagedKVCache, self).attend(
+                layer_state, q, k_new, v_new, rope, q_pos, num_new,
+                sliding_window, attention_fn, scale,
+            )
+        from ..ops.paged_attention import quantized_paged_attention
+
+        lk, lv, lks, lvs = layer_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        new = self._scatter_q(lk, lv, lks, lvs, k_rot, v_new, q_pos, num_new)
+        out = quantized_paged_attention(
+            q_rot, new[0], new[2], new[1], new[3], self.page_table,
+            self.lengths + num_new, scale=scale,
+            sliding_window=sliding_window,
+        )
+        return out, new
+
+    def update_and_gather(self, layer_state, q, k_new, v_new, rope, q_pos,
+                          num_new, sliding_window=None):
+        """Gather fallback: contiguous int8 view dequantized to the model
+        dtype (prefill / non-kernel decode)."""
+        lk, lv, lks, lvs = layer_state
+        b, s, hkv, d = k_new.shape
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        new = self._scatter_q(lk, lv, lks, lvs, k_rot, v_new, q_pos, num_new)
+        nk, nv, nks, nvs = new
+        dt = q.dtype
+
+        def view(pages, scales):
+            g = jnp.take(pages, self.page_table, axis=0).astype(dt)
+            sc = jnp.take(scales, self.page_table, axis=0).astype(dt)
+            return (g * sc[..., None]).transpose(0, 1, 3, 2, 4).reshape(
+                b, self.max_len, hkv, d
+            )
+
+        k_all = view(nk, nks)
+        v_all = view(nv, nvs)
+        kv_pos = jnp.broadcast_to(
+            jnp.arange(self.max_len, dtype=jnp.int32)[None, :],
+            (b, self.max_len),
+        )
+        kv_valid = kv_pos < (self.lengths + num_new)[:, None]
+        mask = causal_mask(q_pos, kv_pos, kv_valid, sliding_window)
+        return q_rot, k_all, v_all, mask, new
+
+    # -- write-behind tail ----------------------------------------------------
+
+    def tail_init(self, k_steps: int):
+        l = self.k_pages.shape[0]
+        b = self.page_table.shape[0]
+        hkv, d = self.k_pages.shape[2], self.k_pages.shape[4]
+        z = jnp.zeros((l, b, k_steps, hkv, d), jnp.bfloat16)
+        return (z, z)
+
+    def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
+                    base_len, tail_len, step_idx, num_new, sliding_window,
+                    scale=None):
+        from ..ops.attention import merge_softmax_segments
+        from ..ops.paged_attention import quantized_paged_attention
+
+        pool_k, pool_v, pool_ks, pool_vs = big_state
+        tk, tv = tail_state
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        tk = jax.lax.dynamic_update_slice_in_dim(
+            tk, k_rot.astype(tk.dtype), step_idx, axis=1
+        )
+        tv = jax.lax.dynamic_update_slice_in_dim(
+            tv, v_new.astype(tv.dtype), step_idx, axis=1
+        )
+
+        q_pos = base_len + tail_len
+        out_pool, m_pool, l_pool = quantized_paged_attention(
+            q_rot, pool_k, pool_ks, pool_v, pool_vs, self.page_table,
+            base_len, scale=scale, sliding_window=sliding_window,
+            q_positions=q_pos, return_stats=True,
+        )
+        kk = tk.shape[1]
+        tail_pos = (
+            base_len[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+        )
+        tail_valid = (
+            jnp.arange(kk, dtype=jnp.int32)[None, :]
+            < (tail_len + num_new)[:, None]
+        )
+        if sliding_window is not None:
+            tail_valid &= tail_pos > (q_pos[:, None] - sliding_window)
+        out = merge_softmax_segments(
+            q_rot, out_pool, m_pool, l_pool,
+            tk.astype(q.dtype), tv.astype(q.dtype), tail_valid, scale,
+        )
+        return out, (tk, tv)
+
+    def tail_flush(self, tail, tail_len):
+        wk, wv = tail  # [L, B, K, Hkv, D] bf16 (keys already rotated)
+        kk = wk.shape[2]
+        q_pos = (
+            self.lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+        )
+        num_new = tail_len
+        new_k, new_v, new_ks, new_vs = jax.vmap(
+            lambda lk, lv, lks, lvs, tkl, tvl: self._scatter_q(
+                lk, lv, lks, lvs, tkl, tvl, q_pos, num_new
+            )
+        )(self.k_pages, self.v_pages, self.ks_pages, self.vs_pages, wk, wv)
+        return self.replace(
+            k_pages=new_k, v_pages=new_v, ks_pages=new_ks, vs_pages=new_vs,
+            lengths=self.lengths + tail_len,
+        )

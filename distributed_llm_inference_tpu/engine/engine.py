@@ -34,7 +34,7 @@ import numpy as np
 
 from ..cache.base import window_ladder
 from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
-from ..cache.paged import PageAllocator, PagedKVCache
+from ..cache.paged import PageAllocator, PagedKVCache, QuantizedPagedKVCache
 from ..cache.sink import SinkKVCache
 from ..config import CacheConfig, EngineConfig, ModelConfig
 from ..models import llama
@@ -104,10 +104,10 @@ class InferenceEngine:
         self._windows: Tuple[int, ...] = ()
         if cc.kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv_quant {cc.kv_quant!r}")
-        if cc.kv_quant is not None and cc.kind != "dense":
+        if cc.kv_quant is not None and cc.kind not in ("dense", "paged"):
             raise ValueError(
                 f"kv_quant={cc.kv_quant!r} is only supported for the dense "
-                f"cache (got kind={cc.kind!r})"
+                f"and paged caches (got kind={cc.kind!r})"
             )
         if cc.prefix_caching and cc.kind != "paged":
             raise ValueError(
@@ -158,7 +158,10 @@ class InferenceEngine:
                 max(1, -(-self._windows[0] // cc.page_size))
                 if self._windows else cc.max_pages_per_session
             )
-            self.cache = PagedKVCache.create(
+            paged_cls = (
+                QuantizedPagedKVCache if cc.kv_quant == "int8" else PagedKVCache
+            )
+            self.cache = paged_cls.create(
                 cfg.num_layers, b, cc.num_pages, cc.page_size,
                 self._first_slots, cfg.num_kv_heads, cfg.head_dim, dtype,
                 use_kernel=self.ecfg.use_pallas_attention,
@@ -223,8 +226,14 @@ class InferenceEngine:
         if (
             attention is None
             and self.ecfg.use_pallas_attention
-            and not isinstance(self.cache, QuantizedDenseKVCache)
+            and not isinstance(
+                self.cache, (QuantizedDenseKVCache, PagedKVCache)
+            )
         ):
+            # Caches with their OWN kernels (int8 dense, paged) must keep
+            # attention unset: swapping in flash here would both force their
+            # dequantizing/gathering fallbacks AND disable the fused tail
+            # path (tail_capable requires the default attention).
             from ..ops.flash_attention import flash_attention
 
             attention = flash_attention  # falls back to XLA on decode shapes

@@ -50,7 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import _NEG_INF
 
-__all__ = ["paged_attention"]
+__all__ = ["paged_attention", "quantized_paged_attention"]
 
 
 def _paged_kernel(
@@ -239,6 +239,197 @@ def paged_attention(
         interpret=interpret,
     )(page_table.astype(jnp.int32), kv_lengths.astype(jnp.int32),
       q_positions.astype(jnp.int32), qr, k_pages, v_pages)
+    out = out.reshape(b, 1, hq, d)
+    if return_stats:
+        return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)
+    return out
+
+
+def _qpaged_kernel(
+    table_ref,  # SMEM [B, T] int32
+    len_ref,    # SMEM [B] int32
+    qpos_ref,   # SMEM [B] int32
+    q_ref,      # [1, Hkv, G, D]
+    k_ref,      # [1, Hkv, PS, D] int8
+    ks_ref,     # [1, Hkv, PS] f32
+    v_ref,      # [1, Hkv, PS, D] int8
+    vs_ref,     # [1, Hkv, PS] f32
+    out_ref,    # [1, Hkv, G, D]
+    m_out_ref,  # [1, Hkv*G, 128] f32
+    l_out_ref,  # [1, Hkv*G, 128] f32
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale: float,
+    page_size: int,
+    num_page_blocks: int,
+    sliding_window: Optional[int],
+    hkv: int,
+    g: int,
+):
+    """int8 page variant of :func:`_paged_kernel`: the per-(slot, head)
+    scales apply to the SCORES/probs (``q·(k·s) = s·(q·k)``), so the int8
+    pages stream through VMEM without a dequantized copy."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[b]
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )
+    valid = pos < kv_len
+    if sliding_window is not None:
+        valid &= pos > qpos_ref[b] - sliding_window
+
+    q = q_ref[0]                      # [Hkv, G, D]
+    k = k_ref[0]                      # [Hkv, PS, D] int8
+    ks = ks_ref[0]                    # [Hkv, PS] f32
+
+    if g == 1:
+        qv = q[:, 0, :][:, None, :].astype(jnp.float32)
+        s = jnp.sum(qv * k.astype(jnp.float32), axis=-1) * ks  # [Hkv, PS]
+    else:
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * ks[:, None, :]
+        s = s.reshape(hkv * g, page_size)
+    s = s * scale
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    v = v_ref[0]                      # [Hkv, PS, D] int8
+    vs = vs_ref[0]                    # [Hkv, PS] f32
+    if g == 1:
+        pw = p.reshape(hkv, page_size) * vs
+        pv = jnp.sum(pw[:, :, None] * v.astype(jnp.float32), axis=1)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+    else:
+        pw = p.reshape(hkv, g, page_size) * vs[:, None, :]
+        pv = jax.lax.dot_general(
+            pw, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(hkv * g, -1)
+
+    @pl.when(j == num_page_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
+
+
+def quantized_paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    ks_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    vs_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    q_positions: Optional[jnp.ndarray] = None,
+    return_stats: bool = False,
+):
+    """As :func:`paged_attention` over int8 pages with per-(slot, head)
+    scale planes (``ks_pages``/``vs_pages``: ``[P, Hkv, page_size]`` f32)."""
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode-only kernel (S=1), got S={s}")
+    _, hkv, page_size, _ = k_pages.shape
+    t = page_table.shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if q_positions is None:
+        q_positions = kv_lengths - 1
+
+    qr = q.reshape(b, hkv, g, d)
+
+    def _page_index(bi, ji, table, lens, qpos):
+        live = ji * page_size < lens[bi]
+        return (jnp.where(live, table[bi, ji], 0), 0, 0, 0)
+
+    def _page_index3(bi, ji, table, lens, qpos):
+        live = ji * page_size < lens[bi]
+        return (jnp.where(live, table[bi, ji], 0), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, t),
+        in_specs=[
+            pl.BlockSpec(
+                (1, hkv, g, d), lambda bi, ji, table, lens, qpos: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, page_size), _page_index3),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, page_size), _page_index3),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, hkv, g, d), lambda bi, ji, table, lens, qpos: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, hkv * g, 128),
+                lambda bi, ji, table, lens, qpos: (bi, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, hkv * g, 128),
+                lambda bi, ji, table, lens, qpos: (bi, 0, 0),
+            ),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv * g, d), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _qpaged_kernel,
+        scale=scale,
+        page_size=page_size,
+        num_page_blocks=t,
+        sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
+    )
+    out, m, l = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lengths.astype(jnp.int32),
+      q_positions.astype(jnp.int32), qr, k_pages, ks_pages, v_pages, vs_pages)
     out = out.reshape(b, 1, hq, d)
     if return_stats:
         return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)

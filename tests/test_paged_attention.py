@@ -164,6 +164,13 @@ def test_paged_tail_engine_parity():
            for _ in range(5)]
     opts = SamplingOptions(max_new_tokens=9)
 
+    calls = {"tail": 0}
+    real = llama.multi_decode_apply
+
+    def spy(*a, **k):
+        calls["tail"] += 1
+        return real(*a, **k)
+
     def run(K, kernel):
         eng = InferenceEngine(
             cfg, params,
@@ -175,7 +182,15 @@ def test_paged_tail_engine_parity():
         )
         return eng.generate(ps_, opts)
 
-    assert run(4, True) == run(1, False)
+    llama.multi_decode_apply = spy
+    try:
+        tail_out = run(4, True)
+    finally:
+        llama.multi_decode_apply = real
+    assert calls["tail"] > 0, (
+        "paged tail path never ran (vacuous parity — the engine gate is dead)"
+    )
+    assert tail_out == run(1, False)
 
 
 def test_paged_kernel_stats_merge_oracle():

@@ -106,3 +106,47 @@ def test_allocator_invariants():
         a.free([pages[0]])  # double free
     with pytest.raises(ValueError):
         a.free([0])  # null page
+
+
+def test_quantized_paged_engine_matches_exact():
+    """int8 page pool (kernel, fused-tail, and XLA-gather paths) agrees with
+    the exact bf16 paged engine."""
+    import numpy as np
+
+    from distributed_llm_inference_tpu.cache.paged import QuantizedPagedKVCache
+    from distributed_llm_inference_tpu.config import (
+        CacheConfig,
+        EngineConfig,
+        ModelConfig,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+    from distributed_llm_inference_tpu.models import llama
+
+    cfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=160,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(51)
+    ps_ = [rng.integers(0, 128, size=int(rng.integers(3, 12))).tolist()
+           for _ in range(5)]
+    opts = SamplingOptions(max_new_tokens=8)
+
+    def run(kv_quant, K, kernel):
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch_size=4, prefill_buckets=(8, 16, 32),
+                         max_seq_len=64, dtype="float32", decode_steps=K,
+                         use_pallas_attention=kernel),
+            CacheConfig(kind="paged", page_size=8, num_pages=64,
+                        max_pages_per_session=8, kv_quant=kv_quant),
+        )
+        if kv_quant:
+            assert isinstance(eng.cache, QuantizedPagedKVCache)
+        return eng.generate(ps_, opts)
+
+    ref = run(None, 1, False)
+    for name, out in (("kernel", run("int8", 1, True)),
+                      ("tail", run("int8", 4, True)),
+                      ("gather", run("int8", 1, False))):
+        agree = sum(a == b for a, b in zip(ref, out))
+        assert agree >= len(ref) - 1, (name, ref, out)
