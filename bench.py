@@ -136,7 +136,10 @@ def _try_decode_bench(
     # as the virtual cap. Under-sizing would silently clamp the last calls'
     # writes and fake the measured traffic.
     k = scan_k if scan_k > 1 else 1
-    writes = (max(1, steps // k) + 1) * k  # +1: the warmup call
+    # Timed calls write steps tokens; the warmup call's k tokens are erased
+    # by resetting lengths afterwards (its writes land below the timed
+    # range and are overwritten), so the buffer needs only the timed span.
+    writes = max(max(1, steps // k) * k, k)
     buf = min(ctx, ctx // 2 + writes)
     cache = cache_cls.create(
         cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim
@@ -178,6 +181,7 @@ def _try_decode_bench(
     tokens = jnp.zeros((batch, 1), jnp.int32)
     tokens, cache = decode(params, tokens, cache)  # compile + warm
     jax.block_until_ready(tokens)
+    cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     t0 = time.perf_counter()
     for _ in range(calls):
         tokens, cache = decode(params, tokens, cache)
@@ -250,7 +254,7 @@ def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16,
     configuration). ``scan_k > 1`` runs the fused write-behind-tail path
     (pool read-only through K steps, pool-segment + tail joint softmax)."""
     k = scan_k if scan_k > 1 else 1
-    writes = (max(1, steps // k) + 1) * k  # +1: the warmup call
+    writes = max(max(1, steps // k) * k, k)  # warmup erased by length reset
     cache = _make_paged_cache(
         cfg.num_layers, batch, min(ctx, ctx // 2 + writes), cfg.num_kv_heads,
         cfg.head_dim, cls=cls,
@@ -290,6 +294,7 @@ def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16,
     tokens = jnp.zeros((batch, 1), jnp.int32)
     tokens, cache = decode(params, tokens, cache)
     jax.block_until_ready(tokens)
+    cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     calls = max(1, steps // per_call)
     t0 = time.perf_counter()
     for _ in range(calls):
