@@ -25,7 +25,6 @@ from distributed_llm_inference_tpu.models import llama
 from distributed_llm_inference_tpu.ops.quant import (
     INT4_WEIGHTS,
     QuantizedTensor,
-    QuantizedTensor4,
     QUANTIZED_WEIGHTS,
 )
 
@@ -79,11 +78,11 @@ def _zero_params(cfg: ModelConfig, dtype=jnp.bfloat16):
     }
 
 
-def _zero_tree(cfg: ModelConfig, quantized_names, make_leaf):
+def _zero_tree(cfg: ModelConfig, quantized_names, make_leaf, dtype=jnp.bfloat16):
     """Zero-weight pytree from config shapes (quantizing a materialized
     13.5 GB bf16 tree would peak above the 16 GB HBM): ``make_leaf`` builds
     the quantized leaves, everything else is zeros (norm gains: ones)."""
-    shapes = jax.eval_shape(lambda: _zero_params(cfg))
+    shapes = jax.eval_shape(lambda: _zero_params(cfg, dtype))
 
     def q(name, w):
         if name not in quantized_names:
@@ -97,26 +96,36 @@ def _zero_tree(cfg: ModelConfig, quantized_names, make_leaf):
     return out
 
 
-def _zero_qparams(cfg: ModelConfig):
+def _zero_qparams(cfg: ModelConfig, dtype=jnp.bfloat16):
     """int8 zero-weight pytree."""
     return _zero_tree(cfg, QUANTIZED_WEIGHTS, lambda w: QuantizedTensor(
         q=jnp.zeros(w.shape, jnp.int8),
-        scale=jnp.ones(w.shape[:-2] + w.shape[-1:], jnp.bfloat16),
-    ))
+        scale=jnp.ones(w.shape[:-2] + w.shape[-1:], dtype),
+    ), dtype)
 
 
-def _zero_q4params(cfg: ModelConfig):
-    """int4 zero-weight pytree (per-channel scales, G=1 — the throughput
-    configuration; grouped scales are the accuracy configuration)."""
+def _zero_q4s_params(cfg: ModelConfig, dtype=jnp.bfloat16):
+    """int4 zero-weight pytree in the half-split Pallas-kernel layout
+    (``ops/quant_matmul.py`` — the r3 throughput configuration; the grouped
+    pair-packed layout is the accuracy configuration and keeps unit-test
+    coverage in tests/test_quant.py)."""
+    from distributed_llm_inference_tpu.ops.quant import QuantizedTensor4Split
+    from distributed_llm_inference_tpu.ops.quant_matmul import (
+        _BIN, _BOUTP, _pad_to,
+    )
 
     def leaf(w):
         *lead, in_dim, out_dim = w.shape
-        return QuantizedTensor4(
-            q=jnp.zeros((*lead, 1, in_dim, out_dim // 2), jnp.int8),
-            scale=jnp.ones((*lead, 1, out_dim), jnp.bfloat16),
+        in_p = _pad_to(in_dim, _BIN)
+        out_p = _pad_to(out_dim, 2 * _BOUTP)
+        return QuantizedTensor4Split(
+            q=jnp.zeros((*lead, in_p, out_p // 2), jnp.int8),
+            scale_lo=jnp.ones((*lead, 1, out_p // 2), jnp.float32),
+            scale_hi=jnp.ones((*lead, 1, out_p // 2), jnp.float32),
+            in_dim=in_dim, out_dim=out_dim,
         )
 
-    return _zero_tree(cfg, INT4_WEIGHTS, leaf)
+    return _zero_tree(cfg, INT4_WEIGHTS, leaf, dtype)
 
 
 def _try_decode_bench(
@@ -141,12 +150,14 @@ def _try_decode_bench(
     # range and are overwritten), so the buffer needs only the timed span.
     writes = max(max(1, steps // k) * k, k)
     buf = min(ctx, ctx // 2 + writes)
+    on_tpu = jax.default_backend() == "tpu"
     cache = cache_cls.create(
-        cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim
+        cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim,
+        jnp.bfloat16 if on_tpu else jnp.float32,
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     num_new = jnp.ones((batch,), jnp.int32)
-    donate = {"donate_argnums": (2,)} if jax.default_backend() == "tpu" else {}
+    donate = {"donate_argnums": (2,)} if on_tpu else {}
 
     if scan_k > 1 and hasattr(cache, "tail_init"):
         active = jnp.ones((batch,), bool)
@@ -190,17 +201,41 @@ def _try_decode_bench(
     return batch * calls * tokens_per_call / dt
 
 
+def _device_time_ms_per_call(fn, reps=3):
+    """Profiled DEVICE time per call of ``fn(rep)`` (jax.profiler trace →
+    xplane parse), or None when no device trace is available (CPU).
+
+    ``fn`` takes the rep index so every call can vary its inputs — the axon
+    tunnel memoizes repeated executions with identical input buffers, which
+    would record fewer real executions than ``reps`` in the trace.
+    """
+    import tempfile
+
+    from distributed_llm_inference_tpu.utils.xplane import device_time_ps
+
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            with jax.profiler.trace(td):
+                for i in range(reps):
+                    jax.block_until_ready(fn(i))
+            ps = device_time_ps(td)
+        return round(ps / 1e9 / reps, 2) if ps else None
+    except Exception:
+        return None
+
+
 def _ttft_bench(cfg, params, prompt_len=128, reps=5, cache_cls=DenseKVCache):
-    """p50 time-to-first-token at bs=1 (prefill + argmax sample), ms.
+    """p50 time-to-first-token at bs=1 (prefill + argmax sample):
+    ``(wall_ms, device_ms)``.
 
     NOTE (this platform): a single synchronous dispatch through the axon
     tunnel pays ~80 ms of round-trip latency that the pipelined decode loop
-    hides; the profiled DEVICE time of this prefill is ~16 ms at 7B/int8
-    (jax.profiler, whole-program while: 16.1 ms/call). On directly-attached
-    hardware the reported TTFT would approach that device time.
+    hides; the profiled DEVICE time (the second element — jax.profiler trace,
+    xplane op total) is what directly-attached hardware would approach.
     """
     cache = cache_cls.create(
-        cfg.num_layers, 1, prompt_len + 8, cfg.num_kv_heads, cfg.head_dim
+        cfg.num_layers, 1, prompt_len + 8, cfg.num_kv_heads, cfg.head_dim,
+        jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
     )
     num_new = jnp.full((1,), prompt_len, jnp.int32)
 
@@ -216,7 +251,16 @@ def _ttft_bench(cfg, params, prompt_len=128, reps=5, cache_cls=DenseKVCache):
         t0 = time.perf_counter()
         jax.block_until_ready(prefill(params, tokens, cache))
         times.append((time.perf_counter() - t0) * 1e3)
-    return float(np.percentile(times, 50))
+    # Vary the tokens per rep: identical input buffers would let the tunnel
+    # memoize and under-record real executions in the trace.
+    # (i % 17) + 1: rep 0 must not collide with the all-zeros buffer the
+    # warmup and wall-timed calls used (the tunnel memoizes identical calls).
+    device_ms = _device_time_ms_per_call(
+        lambda i: prefill(
+            params, jnp.full((1, prompt_len), (i % 17) + 1, jnp.int32), cache
+        )
+    )
+    return float(np.percentile(times, 50)), device_ms
 
 
 def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
@@ -264,7 +308,9 @@ def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16,
     writes = max(max(1, steps // k) * k, k)  # warmup erased by length reset
     cache = _make_paged_cache(
         cfg.num_layers, batch, min(ctx, ctx // 2 + writes), cfg.num_kv_heads,
-        cfg.head_dim, cls=cls,
+        cfg.head_dim,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
+        cls=cls,
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     num_new = jnp.ones((batch,), jnp.int32)
@@ -308,6 +354,74 @@ def _try_paged_decode_bench(cfg, params, batch, ctx, steps=32, scan_k=16,
         tokens, cache = decode(params, tokens, cache)
     jax.block_until_ready(tokens)
     return batch * calls * per_call / (time.perf_counter() - t0)
+
+
+def _try_sink_decode_bench(cfg, params, batch, window, sinks=4, steps=32,
+                           scan_k=16):
+    """Decode throughput of the SINK ring cache mid-stream (ring full, every
+    step evicts) — the reference's signature StreamingLLM capability
+    (``/root/reference/distributed_llm_inference/models/llama/cache.py:111-133``)
+    had no TPU number before r3. No tail path exists for the ring (it evicts
+    on write), so K steps fuse via an in-graph scan of ``model_apply``."""
+    from distributed_llm_inference_tpu.cache.sink import SinkKVCache
+
+    cache = SinkKVCache.create(
+        cfg.num_layers, batch, window, sinks, cfg.num_kv_heads, cfg.head_dim,
+        jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
+    )
+    # Mid-stream state: the ring has wrapped (seen > window), so every timed
+    # write exercises the eviction + window-relative re-rotation path.
+    cache = cache.replace(seen=jnp.full((batch,), window + 7, jnp.int32))
+    num_new = jnp.ones((batch,), jnp.int32)
+    donate = {"donate_argnums": (2,)} if jax.default_backend() == "tpu" else {}
+
+    def decode(params, tokens, cache):
+        def one(carry, _):
+            tok, c = carry
+            logits, c = llama.model_apply(cfg, params, tok, c, num_new)
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None]
+            return (nxt, c), None
+
+        (tok, cache), _ = jax.lax.scan(
+            one, (tokens, cache), None, length=scan_k
+        )
+        return tok, cache
+
+    decode = jax.jit(decode, **donate)
+    tokens = jnp.zeros((batch, 1), jnp.int32)
+    tokens, cache = decode(params, tokens, cache)  # compile + warm
+    jax.block_until_ready(tokens)
+    calls = max(1, steps // scan_k)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        tokens, cache = decode(params, tokens, cache)
+    jax.block_until_ready(tokens)
+    return batch * calls * scan_k / (time.perf_counter() - t0)
+
+
+def _sink_phase() -> dict:
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA2_7B if on_tpu else TINY
+    params = _zero_qparams(cfg, jnp.bfloat16 if on_tpu else jnp.float32)
+    jax.block_until_ready(params)
+    window = 1024 if on_tpu else 32
+    err, best = None, None
+    for batch in ((16, 8, 4) if on_tpu else (4,)):
+        try:
+            tok_s = _try_sink_decode_bench(cfg, params, batch, window)
+        except Exception as e:
+            err = repr(e)
+            continue
+        best = (tok_s, batch)
+        break
+    if best is None:
+        raise RuntimeError(f"all sink configs failed: {err}")
+    return {
+        "tok_s": round(best[0], 2), "batch": best[1], "ttft_ms": None,
+        "window": window, "backend": jax.default_backend(),
+        "device": str(jax.devices()[0].device_kind),
+        "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
+    }
 
 
 def _make_paged_cache(num_layers, batch, max_len, num_kv_heads, head_dim,
@@ -354,32 +468,250 @@ PHASES = {
              DenseKVCache),
     "int8": (_zero_qparams, ((48, 256), (32, 256), (16, 256), (1, 256)),
              DenseKVCache),
-    "int4": (_zero_q4params, ((64, 256), (32, 256), (16, 256), (1, 256)),
+    # int4 weights through the half-split Pallas matmul (ops/quant_matmul.py).
+    "int4": (_zero_q4s_params, ((64, 256), (32, 256), (16, 256), (1, 256)),
              DenseKVCache),
     # int8 weights + int8 KV (per-token/head scales): the KV working set
     # dominates HBM traffic at large batch, so halving it moves the headline.
     "int8_kvq": (_zero_qparams,
                  ((112, 256), (96, 256), (64, 256), (32, 256), (1, 256)),
                  QuantizedDenseKVCache),
-    # int4 weights + int8 KV: weight bytes halve again, freeing HBM for
-    # larger batches on the same chip.
-    "int4_kvq": (_zero_q4params,
-                 ((128, 256), (112, 256), (96, 256), (64, 256), (32, 256)),
-                 QuantizedDenseKVCache),  # peaks at b128; b144+ hits a layout cliff
+    # int4 weights (half-split Pallas matmul) + int8 KV: weight bytes halve
+    # again vs int8, freeing HBM for larger batches on the same chip.
+    "int4_kvq": (_zero_q4s_params,
+                 ((160, 256), (128, 256), (112, 256), (96, 256), (64, 256)),
+                 QuantizedDenseKVCache),
     # int8 weights + Pallas paged-attention kernel over the page pool.
     "paged_pallas": (_zero_qparams, ((48, 256), (32, 256), (16, 256)),
                      "paged"),
     # ...and with int8 pages + scale planes (halved pool bytes buys batch).
     "paged_kvq": (_zero_qparams, ((96, 256), (64, 256), (48, 256)),
                   "paged_kvq"),
+    # Long-context decode (VERDICT r2 order 4): the ladder entries' ctx
+    # makes ~half of it LIVE context, so these report tok/s where KV traffic
+    # dominates (headline phases run ~128-160 live).
+    "int8_kvq_1k": (_zero_qparams, ((24, 2048), (16, 2048), (8, 2048)),
+                    QuantizedDenseKVCache),
+    "int8_kvq_2k": (_zero_qparams, ((12, 4096), (8, 4096), (4, 4096)),
+                    QuantizedDenseKVCache),
+    "paged_kvq_1k": (_zero_qparams, ((16, 2048), (12, 2048), (8, 2048)),
+                     "paged_kvq"),
+    # StreamingLLM sink ring mid-stream (signature feature) — _sink_phase().
+    "sink_1k": None,
+    # Draft+verify speculative serving (BASELINE config 5) — _speculative_phase().
+    "speculative": None,
+    # The SERVING number: InferenceEngine.step() end to end (scheduler,
+    # admission, sampling stack, host⇄device hops) at the int8_kvq headline
+    # configuration — handled by _engine_phase(), not the ladder machinery.
+    "engine_int8_kvq": None,
 }
+
+# Phases that skip the (redundant) prompt-128 TTFT measurement to bound
+# total bench wall time.
+_NO_TTFT = {"int8_kvq_1k", "int8_kvq_2k", "paged_kvq_1k"}
+
+
+def _engine_decode_bench(cfg, params, batch, prompt_len, ticks=6,
+                         decode_steps=None):
+    """Serving-engine throughput: tokens/sec measured THROUGH
+    ``InferenceEngine.step()`` — scheduler lock, admission, sampling-params
+    stacking, numpy⇄device hops, and event delivery all inside the timed
+    window — at the headline int8-weights + int8-KV configuration.
+
+    The engine's auto ``decode_steps`` resolves to the fused write-behind-tail
+    path (K=16), exactly what ``cli.py serve`` now runs by default.
+    """
+    from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
+    from distributed_llm_inference_tpu.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    warm = 1
+    k_guess = decode_steps or 16  # EngineConfig auto default on the tail path
+    max_seq = prompt_len + 1 + (warm + ticks) * k_guess
+    max_seq = ((max_seq + 31) // 32) * 32
+    ecfg = EngineConfig(
+        max_batch_size=batch,
+        max_seq_len=max_seq,
+        prefill_buckets=(prompt_len,),
+        decode_steps=decode_steps,
+        # Fixed full-size buffer: mid-measurement ladder growth would splice
+        # a pad-copy + recompile into the timed ticks.
+        decode_windows=(),
+        # XLA:CPU lacks the bf16 dot the int8-KV attention path emits.
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+    eng = InferenceEngine(
+        cfg, params, ecfg, CacheConfig(kind="dense", kv_quant="int8")
+    )
+    opts = SamplingOptions(max_new_tokens=1_000_000, eos_token_id=-1)
+    gids = [eng.submit([1] * prompt_len, opts) for _ in range(batch)]
+    # First step: admission + `batch` bucketed prefills + the compile/warm
+    # decode tick. Everything after is steady state.
+    eng.step()
+    t0 = time.perf_counter()
+    delivered = 0
+    for _ in range(ticks):
+        for _, tok, _fin in eng.step():
+            if tok != -1:
+                delivered += 1
+    dt = time.perf_counter() - t0
+    if delivered == 0:
+        raise RuntimeError("engine delivered no tokens in the timed window")
+
+    # Engine-level TTFT: drain the load, then time submit→first-token for one
+    # fresh session on warm executables (admission + bucketed prefill + the
+    # sampled first token).
+    for g in gids:
+        eng.cancel(g)
+    eng.step()
+    eng.collect_finished()
+    ttfts = []
+    for _ in range(3):
+        t1 = time.perf_counter()
+        eng.submit([1] * prompt_len,
+                   SamplingOptions(max_new_tokens=1, eos_token_id=-1))
+        ev = eng.step()
+        ttfts.append((time.perf_counter() - t1) * 1e3)
+        assert any(fin for _, _t, fin in ev)
+        eng.collect_finished()
+    return delivered / dt, float(np.percentile(ttfts, 50)), eng.decode_steps
+
+
+def _spec_engine_bench(cfg, dcfg, params, dparams, batch, prompt_len,
+                       ticks=6, spec_k=4):
+    """Speculative serving throughput through ``InferenceEngine.step()``:
+    draft proposes ``spec_k``, target verifies in ONE forward. Returns
+    ``(tok_s, acceptance)`` measured over the timed ticks."""
+    from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
+    from distributed_llm_inference_tpu.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    max_seq = prompt_len + 1 + (1 + ticks) * (spec_k + 1)
+    max_seq = ((max_seq + 31) // 32) * 32
+    ecfg = EngineConfig(
+        max_batch_size=batch, max_seq_len=max_seq,
+        prefill_buckets=(prompt_len,), decode_windows=(),
+        speculative_k=spec_k,
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+    eng = InferenceEngine(
+        cfg, params, ecfg, CacheConfig(kind="dense", kv_quant="int8"),
+        draft=(dcfg, dparams),
+    )
+    opts = SamplingOptions(max_new_tokens=1_000_000, eos_token_id=-1,
+                           speculative=True)
+    for _ in range(batch):
+        eng.submit([1] * prompt_len, opts)
+    eng.step()  # admission + prefills (target & draft) + compile/warm tick
+    s0 = dict(eng.spec_stats)
+    t0 = time.perf_counter()
+    delivered = 0
+    for _ in range(ticks):
+        for _, tok, _fin in eng.step():
+            if tok != -1:
+                delivered += 1
+    dt = time.perf_counter() - t0
+    proposed = eng.spec_stats["proposed"] - s0["proposed"]
+    accepted = eng.spec_stats["accepted"] - s0["accepted"]
+    acc = accepted / proposed if proposed else 0.0
+    return delivered / dt, acc
+
+
+def _speculative_phase() -> dict:
+    """BASELINE config 5's speculative decoding, measured at its two bounds
+    on the chip: zero weights make draft and target agree on every argmax
+    (acceptance = 1 — the mechanism's best case), and a draft doctored to
+    always propose token 1 against a target emitting 0 gives acceptance = 0
+    (worst case: every tick pays k draft forwards + the k+1-position verify
+    for one token). Real-model acceptance lands between; README states the
+    breakeven."""
+    import dataclasses as _dc
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA2_7B if on_tpu else TINY
+    dcfg = _dc.replace(cfg, num_layers=4 if on_tpu else 1)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    params = _zero_qparams(cfg, dt)
+    jax.block_until_ready(params)
+
+    def _disagreeing_draft():
+        dparams = _zero_qparams(dcfg, dt)
+        # embed=1 rides the residual stream to the head (zero matmuls add
+        # nothing); a hot lm_head column then makes argmax = 1 ≠ target's 0.
+        dparams["embed"] = jnp.ones_like(dparams["embed"])
+        lm = dparams["lm_head"]
+        dparams["lm_head"] = QuantizedTensor(
+            q=lm.q.at[:, 1].set(1), scale=lm.scale
+        )
+        return dparams
+
+    err = None
+    for batch in ((48, 32, 16) if on_tpu else (8,)):
+        try:
+            tok_full, acc_full = _spec_engine_bench(
+                cfg, dcfg, params, _zero_qparams(dcfg, dt), batch,
+                prompt_len=128 if on_tpu else 16,
+            )
+            tok_zero, acc_zero = _spec_engine_bench(
+                cfg, dcfg, params, _disagreeing_draft(), batch,
+                prompt_len=128 if on_tpu else 16,
+            )
+        except Exception as e:
+            err = repr(e)
+            continue
+        return {
+            "tok_s": round(tok_full, 2), "batch": batch, "ttft_ms": None,
+            "acceptance": round(acc_full, 3),
+            "tok_s_zero_acceptance": round(tok_zero, 2),
+            "acceptance_zero": round(acc_zero, 3),
+            "spec_k": 4, "draft_layers": dcfg.num_layers,
+            "scope": "InferenceEngine.step() end to end",
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0].device_kind),
+            "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
+        }
+    raise RuntimeError(f"speculative phase failed at every batch: {err}")
+
+
+def _engine_phase() -> dict:
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA2_7B if on_tpu else TINY
+    # float32 on CPU: XLA:CPU lacks the bf16 dot the int8-KV path emits.
+    params = _zero_qparams(cfg, jnp.bfloat16 if on_tpu else jnp.float32)
+    jax.block_until_ready(params)
+    err = None
+    for batch in ((112, 96, 64) if on_tpu else (8,)):
+        try:
+            tok_s, ttft, k = _engine_decode_bench(
+                cfg, params, batch, prompt_len=128 if on_tpu else 16
+            )
+        except Exception as e:
+            err = repr(e)
+            continue
+        return {
+            "tok_s": round(tok_s, 2), "batch": batch,
+            "ttft_ms": round(ttft, 2), "decode_steps": k,
+            "scope": "InferenceEngine.step() end to end",
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0].device_kind),
+            "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
+        }
+    raise RuntimeError(f"engine phase failed at every batch: {err}")
 
 
 def run_phase(name: str) -> dict:
     on_tpu = jax.default_backend() == "tpu"
     cfg = LLAMA2_7B if on_tpu else TINY
+    if name == "engine_int8_kvq":
+        return _engine_phase()
+    if name == "sink_1k":
+        return _sink_phase()
+    if name == "speculative":
+        return _speculative_phase()
     build, ladder, cache_cls = PHASES[name]
-    params = build(cfg)
+    # float32 on CPU throughout: XLA:CPU lacks several bf16 kernels the
+    # quantized paths emit.
+    params = build(cfg, jnp.bfloat16 if on_tpu else jnp.float32)
     jax.block_until_ready(params)
     if cache_cls in ("paged", "paged_kvq"):
         from distributed_llm_inference_tpu.cache.paged import (
@@ -405,12 +737,18 @@ def run_phase(name: str) -> dict:
         if best is None:
             raise RuntimeError(f"all paged configs failed: {err}")
         tok_s, batch = best
-        ttft = _ttft_bench(cfg, params, cache_cls=_PagedTTFTCache)
+        ttft = ttft_dev = None
+        if name not in _NO_TTFT:
+            ttft, ttft_dev = _ttft_bench(cfg, params, cache_cls=_PagedTTFTCache)
     else:
         tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)
-        ttft = _ttft_bench(cfg, params, cache_cls=cache_cls)
+        ttft = ttft_dev = None
+        if name not in _NO_TTFT:
+            ttft, ttft_dev = _ttft_bench(cfg, params, cache_cls=cache_cls)
     return {
-        "tok_s": round(tok_s, 2), "batch": batch, "ttft_ms": round(ttft, 2),
+        "tok_s": round(tok_s, 2), "batch": batch,
+        "ttft_ms": round(ttft, 2) if ttft is not None else None,
+        "ttft_device_ms": ttft_dev,
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0].device_kind),
         "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
@@ -464,15 +802,35 @@ def main():
             results[name] = {"tok_s": 0.0, "batch": 0, "ttft_ms": None,
                              "error": f"{sub_err}; {repr(e)[:150]}"}
 
-    best_dtype = max(results, key=lambda n: results[n]["tok_s"])
+    # Headline = best full-context decode phase. The speculative phase's
+    # number is measured at acceptance=1.0 by construction and the sink ring
+    # reads a bounded window — neither is comparable decode work.
+    _NON_HEADLINE = {"speculative", "sink_1k"}
+    best_dtype = max(
+        (n for n in results if n not in _NON_HEADLINE),
+        key=lambda n: results[n]["tok_s"],
+    )
     best = results[best_dtype]
-    ttfts = [r["ttft_ms"] for r in results.values() if r["ttft_ms"] is not None]
+    # The engine phase's TTFT ("scope" key) measures submit→first-token
+    # through the scheduler — a different scope than the prefill-only phases;
+    # keep it out of the prefill-TTFT aggregate.
+    ttfts = [
+        r["ttft_ms"] for r in results.values()
+        if r.get("ttft_ms") is not None and "scope" not in r
+    ]
+    dev_ttfts = [
+        r.get("ttft_device_ms") for r in results.values()
+        if r.get("ttft_device_ms")
+    ]
+    eng = results.get("engine_int8_kvq", {})
     print(json.dumps({
         "metric": "llama2_7b_decode_tok_per_sec_per_chip",
         "value": best["tok_s"],
         "unit": "tokens/sec/chip",
         "vs_baseline": round(best["tok_s"] / NORTH_STAR_TOK_S_CHIP, 4),
+        "engine_tok_s": eng.get("tok_s"),
         "p50_ttft_ms_bs1_prompt128": min(ttfts) if ttfts else None,
+        "p50_ttft_device_ms": min(dev_ttfts) if dev_ttfts else None,
         "batch": best["batch"],
         "weights": {"bf16": "bfloat16"}.get(best_dtype, best_dtype),
         **results,

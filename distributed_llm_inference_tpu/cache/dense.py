@@ -297,6 +297,29 @@ class DenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         mask = self._mask(q, q_pos, num_new, sliding_window)
         return q_rot, new_k, new_v, mask, (new_k, new_v)
 
+    def ingest_row(self, ks, vs, n_valid):
+        """Install ring-prefill KV — ``[L, B, S, Hkv, D]``, keys already
+        rotated (``parallel/ring.py:ring_prefill`` output; ``B`` matches this
+        cache's batch, 1 for the engine's per-admission sub-cache) — as the
+        rows' prefix; ``lengths`` ← ``n_valid`` (scalar or ``[B]``). ``S``
+        beyond ``max_len`` is cropped (ring buckets round up past the buffer;
+        callers guarantee ``n_valid <= max_len``)."""
+        t = self.max_len
+        s = ks.shape[2]
+        if s >= t:
+            k_new, v_new = ks[:, :, :t], vs[:, :, :t]
+        else:
+            pad = [(0, 0), (0, 0), (0, t - s), (0, 0), (0, 0)]
+            k_new, v_new = jnp.pad(ks, pad), jnp.pad(vs, pad)
+        lengths = jnp.broadcast_to(
+            jnp.asarray(n_valid, jnp.int32), self.lengths.shape
+        )
+        return self.replace(
+            k=k_new.astype(self.k.dtype),
+            v=v_new.astype(self.v.dtype),
+            lengths=lengths,
+        )
+
     # -- write-behind tail (fused multi-step decode) --------------------------
 
     def tail_init(self, k_steps: int):
@@ -540,6 +563,34 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         )
         mask = self._mask(q, q_pos, num_new, sliding_window)
         return q_rot, k_all, v_all, mask, (new_k, new_v, new_ks, new_vs)
+
+    def ingest_row(self, ks, vs, n_valid):
+        """Ring-prefill ingest (cf. :meth:`DenseKVCache.ingest_row`):
+        quantize the ``[L, B, S, Hkv, D]`` ring KV per (token, head) and lay
+        it out head-major."""
+        k_q, k_s = _quantize_kv(ks)  # [L, 1, S, H, D] / [L, 1, S, H]
+        v_q, v_s = _quantize_kv(vs)
+        k_q = jnp.moveaxis(k_q, 2, 3)  # [L, 1, H, S, D]
+        v_q = jnp.moveaxis(v_q, 2, 3)
+        k_s = jnp.swapaxes(k_s, 2, 3)  # [L, 1, H, S]
+        v_s = jnp.swapaxes(v_s, 2, 3)
+        t = self.max_len
+        s = ks.shape[2]
+
+        def fit(a):
+            if s >= t:
+                return jax.lax.slice_in_dim(a, 0, t, axis=3)
+            widths = [(0, 0)] * a.ndim
+            widths[3] = (0, t - s)
+            return jnp.pad(a, widths)
+
+        return self.replace(
+            k=fit(k_q), v=fit(v_q),
+            ks=fit(k_s.astype(jnp.float32)), vs=fit(v_s.astype(jnp.float32)),
+            lengths=jnp.broadcast_to(
+                jnp.asarray(n_valid, jnp.int32), self.lengths.shape
+            ),
+        )
 
     # -- write-behind tail (fused multi-step decode) --------------------------
 

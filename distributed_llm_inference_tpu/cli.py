@@ -313,9 +313,10 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--dtype", default="bfloat16")
     l.add_argument("--weights-cache", default=None,
                    help="directory for pre-converted weight caching")
-    l.add_argument("--decode-steps", type=int, default=1,
+    l.add_argument("--decode-steps", type=int, default=None,
                    help="fused decode steps per dispatch (tokens stream "
-                        "every K steps; big throughput win on TPU)")
+                        "every K steps; big throughput win on TPU). Default: "
+                        "auto — 16 where the fused tail path composes, else 1")
     l.add_argument("--speculative-draft", default=None,
                    help="draft model checkpoint dir: greedy speculative "
                         "decoding (same tokenizer/vocab as --model)")

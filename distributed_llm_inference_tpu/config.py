@@ -189,6 +189,14 @@ class EngineConfig:
     # round trip costs ~50 ms through the tunnel at 7B shapes — far more than
     # the step's HBM traffic — so K-step decode multiplies throughput.
     # Tradeoff: tokens stream to consumers every K steps, not every step.
-    decode_steps: int = 1
+    # None (default) = auto: 16 when the engine's fused write-behind-tail
+    # path composes with the cache/mesh (the headline configuration), else 1
+    # (pp meshes and caches without a tail path keep per-token dispatch).
+    decode_steps: Optional[int] = None
+    # Prompts longer than this prefill sequence-sharded over the mesh's
+    # ``sp`` ring (engines with mesh_cfg.sp > 1 and a dense cache kind)
+    # instead of chunked single-device prefill. None = the largest prefill
+    # bucket.
+    ring_prefill_threshold: Optional[int] = None
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled

@@ -80,8 +80,21 @@ class InferenceEngine:
         if self.ecfg.quantization in ("int8", "int4"):
             from ..ops.quant import quantize_params
 
+            qkw = {}
+            if self.ecfg.quantization == "int4":
+                # Unsharded (or dp/ep-only) serving decodes through the
+                # Pallas half-split kernel; tp/pp meshes keep the grouped
+                # XLA layout (the packed channel order doesn't column-shard),
+                # with group counts divisible by tp (whole groups per device).
+                solo = mesh_cfg is None or (
+                    mesh_cfg.tp == 1 and mesh_cfg.pp == 1
+                )
+                qkw["int4_layout"] = "split" if solo else "grouped"
+                if not solo:
+                    qkw["group_multiple"] = mesh_cfg.tp
             params = quantize_params(
-                params, bits=4 if self.ecfg.quantization == "int4" else 8
+                params, bits=4 if self.ecfg.quantization == "int4" else 8,
+                **qkw,
             )
         elif self.ecfg.quantization is not None:
             raise ValueError(f"unknown quantization {self.ecfg.quantization!r}")
@@ -130,11 +143,10 @@ class InferenceEngine:
             # bandwidth tracks the LIVE context, not max_seq_len: a padded
             # max-size buffer costs ~30% of decode throughput at 7B shapes
             # early in long-context serving. Growth re-creates buffers and
-            # re-applies the mesh shardings (_reshard_cache); pp/dp meshes
-            # stay fixed-size (the pipelined program's specs are
-            # shape-coupled).
-            grow_ok = mesh_cfg is None or (mesh_cfg.pp == 1 and mesh_cfg.dp == 1)
-            self._windows = self._window_ladder() if grow_ok else ()
+            # re-applies the mesh shardings (_reshard_cache) — under pp/dp
+            # meshes too: each bucket shape compiles its own pipelined
+            # executable exactly as the plain path does.
+            self._windows = self._window_ladder()
             first = self._windows[0] if self._windows else self.ecfg.max_seq_len
             self.cache = cache_cls.create(
                 cfg.num_layers, b, first, cfg.num_kv_heads,
@@ -186,10 +198,19 @@ class InferenceEngine:
             )
 
             if mesh_cfg.sp != 1:
-                raise ValueError(
-                    "sequence parallelism is a prefill-side program "
-                    f"(parallel/ring.py), not an engine axis (got {mesh_cfg})"
-                )
+                # sp is a PREFILL-side program (parallel/ring.py): prompts
+                # past the ring threshold prefill sequence-sharded over sp,
+                # then hand their KV to the (sp-replicated) decode path.
+                if mesh_cfg.pp != 1:
+                    raise ValueError(
+                        "sp>1 ring prefill does not compose with pp serving "
+                        f"(got {mesh_cfg})"
+                    )
+                if cc.kind != "dense":
+                    raise ValueError(
+                        "sp>1 ring prefill requires a dense cache kind (it "
+                        f"ingests contiguous ring KV; got kind={cc.kind!r})"
+                    )
             if mesh_cfg.pp > 1 and cc.kind != "dense":
                 raise ValueError(
                     f"pp>1 serving requires the dense cache (got {cc.kind!r})"
@@ -283,7 +304,6 @@ class InferenceEngine:
             token = sample(logits[:, 0], key, sp)
             return token, cache
 
-        K = self.ecfg.decode_steps
         # The write-behind tail composes with tp/ep/dp sharding (its scalar
         # slot writes and flush gather partition) but not with the staged
         # pipeline program, which pp engines use per step instead. The paged
@@ -300,6 +320,15 @@ class InferenceEngine:
                 )
             )
         )
+        # decode_steps=None (the default) resolves to the fused fast path
+        # wherever it composes: the engine should serve its best configuration
+        # out of the box, not behind a flag.
+        self.decode_steps = (
+            self.ecfg.decode_steps
+            if self.ecfg.decode_steps is not None
+            else (16 if tail_capable else 1)
+        )
+        K = self.decode_steps
 
         def _decode_scan(params, tokens, cache, active, key, sp, eos_ids, budget):
             """``K`` fused decode steps in one dispatch: sampling, EOS stops,
@@ -345,6 +374,32 @@ class InferenceEngine:
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
         self._decode_k = self._with_mesh(jax.jit(_decode_scan, **dk))
+
+        # -- ring (sequence-parallel) prefill (SURVEY §5.7) -------------------
+        self._ring_prefill = None
+        self._sp = 1
+        if mesh_cfg is not None and mesh_cfg.sp > 1:
+            from ..parallel.ring import ring_prefill
+
+            self._sp = mesh_cfg.sp
+            mesh = self.mesh
+
+            def _ring_prefill_row(params, tokens, cache, row, n_valid, key, sp):
+                """One admitted session's prompt, sequence-sharded over the
+                ``sp`` ring; the resulting KV is quantized/laid out by the
+                cache's ``ingest_row`` and decode proceeds identically to a
+                chunked prefill."""
+                logits, ks, vs = ring_prefill(
+                    cfg, params, tokens, n_valid[None], mesh
+                )
+                sub = cache.select_row(row).ingest_row(ks, vs, n_valid)
+                cache = cache.merge_row(sub, row)
+                token = sample(logits[:, 0], key, sp)
+                return token[0], cache
+
+            self._ring_prefill = self._with_mesh(
+                jax.jit(_ring_prefill_row, **dk)
+            )
 
         # -- speculative decoding (draft model; BASELINE config 5) ------------
         self.draft = None
@@ -693,13 +748,55 @@ class InferenceEngine:
             self.slots[slot] = s.generation_id
             self._run_prefill(s, produced, skip=shared_len)
 
+    def _ring_threshold(self) -> int:
+        thr = self.ecfg.ring_prefill_threshold
+        return thr if thr is not None else self.ecfg.prefill_buckets[-1]
+
+    def _ring_bucket(self, n: int) -> int:
+        """Padded ring length for an ``n``-token prompt: the doubling ladder
+        above the largest prefill bucket, capped at ``max_seq_len`` (the
+        ingest crop would discard anything above it — computing attention
+        over up-to-2x padding would be pure waste), rounded up to a multiple
+        of the ``sp`` degree (one executable per bucket)."""
+        b = self.ecfg.prefill_buckets[-1]
+        while b < n:
+            b *= 2
+        b = min(b, max(n, self.ecfg.max_seq_len))
+        return -(-b // self._sp) * self._sp
+
     def _run_prefill(self, s: Session, produced, skip: int = 0) -> None:
         """Chunked, bucketed prefill of one admitted session; samples the
         first generated token from the final chunk. ``skip`` tokens at the
         head are already in the cache (shared prefix pages) — the row's
-        write offset (``lengths``) was set past them at admission."""
+        write offset (``lengths``) was set past them at admission.
+
+        Prompts past the ring threshold on an ``sp>1`` engine prefill
+        sequence-sharded over the ring instead (one dispatch for the whole
+        prompt; each sp device computes ``bucket/sp`` positions)."""
         chunk_cap = self._max_chunk()
         prompt = np.asarray(s.prompt, np.int32)
+        sp = SamplingParams.create(
+            1, s.options.temperature, s.options.top_k, s.options.top_p
+        )
+        if (
+            self._ring_prefill is not None
+            and skip == 0
+            and len(prompt) > self._ring_threshold()
+        ):
+            bucket = self._ring_bucket(len(prompt))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(prompt)] = prompt
+            with self.metrics.timer("prefill"), span(
+                "ring_prefill", self.spans,
+                generation_id=s.generation_id, prompt_tokens=len(s.prompt),
+            ):
+                token, self.cache = self._ring_prefill(
+                    self.params, jnp.asarray(padded), self.cache, s.slot,
+                    jnp.int32(len(prompt)), self._next_key(), sp,
+                )
+            self.metrics.counter("ring_prefills")
+            self._finish_prefill(s, int(token), prompt, produced, skip)
+            return
         offset = skip
         with self.metrics.timer("prefill"), span(
             "prefill", self.spans,
@@ -716,13 +813,13 @@ class InferenceEngine:
             bucket = self._bucket_for(len(rest))
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(rest)] = rest
-            sp = SamplingParams.create(
-                1, s.options.temperature, s.options.top_k, s.options.top_p
-            )
             token, self.cache = self._prefill(
                 self.params, jnp.asarray(padded), self.cache, s.slot,
                 jnp.int32(len(rest)), self._next_key(), sp,
             )
+        self._finish_prefill(s, int(token), prompt, produced, skip)
+
+    def _finish_prefill(self, s, token, prompt, produced, skip):
         self._deliver(s, int(token), produced)
         self.metrics.counter("prefill_tokens", len(s.prompt) - skip)
         if self._session_speculative(s):
@@ -760,7 +857,7 @@ class InferenceEngine:
             for g in self.slots
         ):
             return self._speculative_tick(produced)
-        K = max(1, self.ecfg.decode_steps)
+        K = max(1, self.decode_steps)
         tokens = np.zeros((self.batch, 1), np.int32)
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
         for slot, gid in enumerate(self.slots):

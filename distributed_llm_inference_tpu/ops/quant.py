@@ -36,8 +36,10 @@ from flax import struct
 __all__ = [
     "QuantizedTensor",
     "QuantizedTensor4",
+    "QuantizedTensor4Split",
     "quantize_int8",
     "quantize_int4",
+    "quantize_int4_split",
     "matmul",
     "quantize_params",
     "QUANTIZED_WEIGHTS",
@@ -112,6 +114,76 @@ class QuantizedTensor4(struct.PyTreeNode):
         return q4.reshape(*lead, g, gs, out_packed * 2)
 
 
+class QuantizedTensor4Split(struct.PyTreeNode):
+    """int4 weight in the Pallas decode-matmul layout (half-split packing).
+
+    ``q``: int8 ``[..., in_pad, out_pad // 2]`` — byte column ``j`` holds
+    channel ``j`` (low nibble) and channel ``j + out_pad/2`` (high nibble);
+    padded to the kernel's tile multiples at quantization time (see
+    ``ops/quant_matmul.py``). ``scale_lo``/``scale_hi``: f32
+    ``[..., 1, out_pad // 2]`` per-output-channel scales for the two halves —
+    stored PRE-SPLIT so the kernel call slices nothing per step (a
+    ``[2, outp]`` array would need per-call row slices that XLA materializes,
+    and a (1, x) block of a 2-row array is not a legal Mosaic tile). Coarser
+    than :class:`QuantizedTensor4`'s grouped scales (per-channel only) but
+    decode reads stream straight through the MXU kernel — this is the
+    throughput configuration; grouped pair-packing is the accuracy
+    configuration.
+    """
+
+    q: jax.Array
+    scale_lo: jax.Array
+    scale_hi: jax.Array
+    in_dim: int = struct.field(pytree_node=False, default=0)
+    out_dim: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def shape(self):
+        return (*self.q.shape[:-2], self.in_dim, self.out_dim)
+
+    @property
+    def dtype(self):
+        return self.scale_lo.dtype
+
+    def full_scale(self) -> jax.Array:
+        """``[..., out_pad]`` concatenated per-channel scales (fallback /
+        oracle paths)."""
+        return jnp.concatenate(
+            [self.scale_lo, self.scale_hi], axis=-1
+        ).reshape(*self.q.shape[:-2], -1)
+
+
+def quantize_int4_split(w: jax.Array) -> QuantizedTensor4Split:
+    """Symmetric per-output-channel int4 in the half-split Pallas layout.
+
+    Scales are always f32: the kernel accumulates in f32 and multiplies the
+    scales in at the epilogue, so there is no bf16 round trip to save, and
+    per-channel scale bytes are noise next to the packed weights.
+    """
+    from .quant_matmul import pack_int4_split
+
+    *lead, in_dim, out = w.shape
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -7, 7).astype(
+        jnp.int8
+    )
+    packed = pack_int4_split(q)
+    out_pad = packed.shape[-1] * 2
+    sc = jnp.pad(
+        scale.squeeze(-2).astype(jnp.float32),
+        [(0, 0)] * len(lead) + [(0, out_pad - out)],
+    )
+    half = out_pad // 2
+    return QuantizedTensor4Split(
+        q=packed,
+        scale_lo=sc[..., None, :half],
+        scale_hi=sc[..., None, half:],
+        in_dim=in_dim,
+        out_dim=out,
+    )
+
+
 def quantize_int8(w: jax.Array, scale_dtype=jnp.bfloat16) -> QuantizedTensor:
     """Symmetric per-output-channel int8 quantization of ``[..., in, out]``."""
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
@@ -165,6 +237,24 @@ def matmul(x: jax.Array, w) -> jax.Array:
     if isinstance(w, QuantizedTensor):
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(x.dtype)
+    if isinstance(w, QuantizedTensor4Split):
+        import numpy as np
+
+        from .quant_matmul import int4_matmul, unpack_int4_split
+
+        if w.q.ndim != 2:
+            raise ValueError(
+                "QuantizedTensor4Split matmul expects a per-layer 2D packed "
+                f"weight (scan-sliced), got shape {w.q.shape}"
+            )
+        rows = int(np.prod(x.shape[:-1]))
+        if rows <= 256:
+            return int4_matmul(x, w.q, w.scale_lo, w.scale_hi, w.out_dim)
+        # Many-row (prefill) calls: plain XLA dequant matmul — the unpack is
+        # amortized over the rows and the MXU shape is already efficient.
+        w4 = unpack_int4_split(w.q)[: x.shape[-1]]
+        y = x @ w4.astype(x.dtype)
+        return (y * w.full_scale().astype(x.dtype))[..., : w.out_dim]
     if isinstance(w, QuantizedTensor4):
         g, gs, outp = w.q.shape[-3:]
         # Contract over the bitcast layout DIRECTLY — reshaping the s4 view
@@ -202,21 +292,35 @@ def quantize_params(
     scale_dtype=jnp.bfloat16,
     bits: int = 8,
     group_size: int = 128,
+    int4_layout: str = "grouped",
+    group_multiple: int = 1,
 ) -> Dict[str, Any]:
     """Quantize the named weights in a param pytree (full-model or block-only);
     everything else passes through unchanged.
 
-    ``bits=4`` uses group-wise int4 for the dense projections
-    (:data:`INT4_WEIGHTS`); MoE expert stacks stay int8 (the ``einsum``
-    helper's scale broadcast doesn't cover grouped contraction). The group
-    size degrades to ``gcd(group_size, in_dim)`` so small test shapes divide.
+    ``bits=4`` uses int4 for the dense projections (:data:`INT4_WEIGHTS`);
+    MoE expert stacks stay int8 (the ``einsum`` helper's scale broadcast
+    doesn't cover grouped contraction). ``int4_layout``: "grouped" =
+    pair-packed group-wise scales (accuracy configuration, XLA path; group
+    size degrades to ``gcd(group_size, in_dim)`` so small test shapes
+    divide); "split" = half-split per-channel layout consumed by the Pallas
+    decode matmul (throughput configuration, ``ops/quant_matmul.py``).
+    ``group_multiple``: force the group COUNT divisible by this — tp-sharded
+    serving puts the contracted-axis sharding on the group axis (whole groups
+    per device, ``parallel/tp.py``), so engines pass their tp degree.
     """
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if int4_layout not in ("grouped", "split"):
+        raise ValueError(f"unknown int4_layout {int4_layout!r}")
 
     def quantize_one(name, w):
         if bits == 4 and name in INT4_WEIGHTS and w.shape[-1] % 2 == 0:
+            if int4_layout == "split":
+                return quantize_int4_split(w)
             gs = math.gcd(group_size, w.shape[-2])
+            while gs > 1 and (w.shape[-2] // gs) % group_multiple:
+                gs //= 2
             return quantize_int4(w, gs, scale_dtype)
         return quantize_int8(w, scale_dtype)
 

@@ -220,13 +220,13 @@ def dense_cache_from_ring(
 ):
     """Build a :class:`cache.dense.DenseKVCache` (lengths advanced) from
     ring-prefill KV, ready for standard decode. ``max_seq_len`` ≥ the prefill
-    length."""
+    length. Thin wrapper over the cache's ``ingest_row`` (the single home of
+    the ring-KV-to-dense layout contract — the engine's serving path uses it
+    on a batch-1 sub-cache)."""
     from ..cache.dense import DenseKVCache
 
-    s = ks.shape[2]
+    l, b, s, hkv, d = ks.shape
     if max_seq_len < s:
         raise ValueError(f"max_seq_len {max_seq_len} < prefill length {s}")
-    pad = [(0, 0), (0, 0), (0, max_seq_len - s), (0, 0), (0, 0)]
-    return DenseKVCache(
-        k=jnp.pad(ks, pad), v=jnp.pad(vs, pad), lengths=num_new.astype(jnp.int32)
-    )
+    cache = DenseKVCache.create(l, b, max_seq_len, hkv, d, ks.dtype)
+    return cache.ingest_row(ks, vs, num_new)

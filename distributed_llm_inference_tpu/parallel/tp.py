@@ -87,7 +87,9 @@ def _maybe_qspec(param: Any, spec: P) -> Any:
     contracted (second-to-last) axis of the weight spec. int4 grouped
     weights ``[..., G, gs, out]`` carry the contracted axis's sharding on the
     group axis (whole groups per device), replicating within a group."""
-    from ..ops.quant import QuantizedTensor, QuantizedTensor4
+    from ..ops.quant import (
+        QuantizedTensor, QuantizedTensor4, QuantizedTensor4Split,
+    )
 
     if isinstance(param, QuantizedTensor):
         return QuantizedTensor(q=spec, scale=P(*spec[:-2], spec[-1]))
@@ -95,6 +97,20 @@ def _maybe_qspec(param: Any, spec: P) -> Any:
         return QuantizedTensor4(
             q=P(*spec[:-2], spec[-2], None, spec[-1]),
             scale=P(*spec[:-2], spec[-2], spec[-1]),
+        )
+    if isinstance(param, QuantizedTensor4Split):
+        # Half-split packing interleaves channel j with j + out_pad/2 in one
+        # byte column: a tp column shard of the packed axis would hold a
+        # non-contiguous channel set and scramble the row-parallel concat
+        # order. Replicate in/out axes (layer/pp lead axes keep their spec);
+        # tp>1 int4 serving uses the grouped XLA layout instead. in/out_dim
+        # are STATIC aux data and must match the param's or tree.map raises.
+        return QuantizedTensor4Split(
+            q=P(*spec[:-2], None, None),
+            scale_lo=P(*spec[:-2], None, None),
+            scale_hi=P(*spec[:-2], None, None),
+            in_dim=param.in_dim,
+            out_dim=param.out_dim,
         )
     return spec
 

@@ -253,10 +253,15 @@ def test_engine_tp_mesh_matches_single_device():
 def test_engine_mesh_rejects_bad_configs():
     from distributed_llm_inference_tpu.config import MeshConfig
 
-    with pytest.raises(ValueError):  # sp is a prefill program, not an axis
+    with pytest.raises(ValueError):  # ring prefill needs a dense cache kind
         InferenceEngine(
             CFG, PARAMS, EngineConfig(max_batch_size=2, dtype="float32"),
-            CacheConfig(kind="dense"), mesh_cfg=MeshConfig(sp=2),
+            CacheConfig(kind="paged"), mesh_cfg=MeshConfig(sp=2),
+        )
+    with pytest.raises(ValueError):  # sp does not compose with pp serving
+        InferenceEngine(
+            CFG, PARAMS, EngineConfig(max_batch_size=4, dtype="float32"),
+            CacheConfig(kind="dense"), mesh_cfg=MeshConfig(pp=2, sp=2),
         )
     with pytest.raises(ValueError):  # batch must divide by pp*dp
         InferenceEngine(
@@ -268,6 +273,68 @@ def test_engine_mesh_rejects_bad_configs():
             CFG, PARAMS, EngineConfig(max_batch_size=4, dtype="float32"),
             CacheConfig(kind="paged"), mesh_cfg=MeshConfig(pp=2),
         )
+
+
+def _ring_engine(kv_quant=None, sp=2, batch=2):
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=batch, prefill_buckets=(8, 16),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="dense", kv_quant=kv_quant),
+        mesh_cfg=MeshConfig(sp=sp),
+    )
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_engine_ring_prefill_matches_solo(kv_quant):
+    """Prompts past the ring threshold prefill sequence-sharded over sp and
+    decode to the SAME tokens as the plain single-device engine (VERDICT r2
+    order 5: the capability must be servable, not a library function)."""
+    rng = np.random.default_rng(7)
+    long_prompts = [
+        rng.integers(0, CFG.vocab_size, size=n).tolist() for n in (24, 37)
+    ]
+    opts = SamplingOptions(max_new_tokens=6)
+    plain = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="dense", kv_quant=kv_quant),
+    ).generate(long_prompts, opts)
+    eng = _ring_engine(kv_quant)
+    assert eng.generate(long_prompts, opts) == plain
+    assert eng.metrics.snapshot().get("ring_prefills") == 2
+
+
+def test_engine_ring_prefill_short_prompts_keep_bucketed_path():
+    """Prompts at/below the threshold keep the chunked bucketed prefill."""
+    ps = prompts(3, lo=3, hi=10, seed=21)
+    opts = SamplingOptions(max_new_tokens=5)
+    plain = make_engine("dense", batch=2).generate(ps, opts)
+    eng = _ring_engine()
+    assert eng.generate(ps, opts) == plain
+    assert eng.metrics.snapshot().get("ring_prefills") is None
+
+
+def test_engine_ring_prefill_composes_with_tp():
+    """sp=2 × tp=2: ring prefill inside a mesh that also tensor-shards."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    rng = np.random.default_rng(9)
+    long_prompts = [rng.integers(0, CFG.vocab_size, size=29).tolist()]
+    opts = SamplingOptions(max_new_tokens=5)
+    plain = make_engine("dense", batch=2).generate(long_prompts, opts)
+    eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(sp=2, tp=2),
+    )
+    assert eng.generate(long_prompts, opts) == plain
+    assert eng.metrics.snapshot().get("ring_prefills") == 1
 
 
 def test_engine_tp_pp_dp_continuous_batching_matches_solo():
@@ -513,3 +580,32 @@ def test_cancel_active_session_frees_slot():
     assert eng.sessions[a].finish_reason == "cancelled"
     assert len(eng.sessions[a].generated) <= 5  # stopped promptly
     assert len(eng.sessions[b].generated) == 3  # b got the slot and finished
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(pp=2), dict(pp=2, dp=2)])
+def test_engine_growth_ladder_under_pp_dp(mesh_kw):
+    """The decode-window growth ladder works under pp/dp serving meshes
+    (VERDICT r2 order 6): the buffer starts at the smallest bucket, grows
+    mid-serving (per-bucket pipelined executables + re-shard), and tokens
+    match the solo engine exactly."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    rng = np.random.default_rng(13)
+    ps = [rng.integers(0, CFG.vocab_size, size=6).tolist() for _ in range(4)]
+    opts = SamplingOptions(max_new_tokens=24)  # 6 + 24 > first bucket 16
+    plain = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=4, prefill_buckets=(8, 16), max_seq_len=64,
+                     dtype="float32", decode_windows=(16, 64)),
+        CacheConfig(kind="dense"),
+    ).generate(ps, opts)
+    eng = InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=4, prefill_buckets=(8, 16), max_seq_len=64,
+                     dtype="float32", decode_windows=(16, 64)),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(**mesh_kw),
+    )
+    assert eng.generate(ps, opts) == plain
+    assert eng.metrics.snapshot().get("cache_growths", 0) >= 1
+    assert eng.cache.max_len == 64  # grew off the first bucket

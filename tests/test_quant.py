@@ -269,6 +269,119 @@ def test_engine_int4_generates():
         ),
         CacheConfig(kind="dense"),
     )
-    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor4)
+    # Unsharded serving quantizes into the half-split Pallas-kernel layout.
+    from distributed_llm_inference_tpu.ops.quant import QuantizedTensor4Split
+
+    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor4Split)
     outs = eng.generate([[1, 2, 3]], SamplingOptions(temperature=0.0, max_new_tokens=5))
     assert len(outs[0]) == 5
+
+
+# -- int4 half-split Pallas layout (ops/quant_matmul.py) ----------------------
+
+
+def test_int4_split_pack_unpack_roundtrip():
+    from distributed_llm_inference_tpu.ops.quant_matmul import (
+        pack_int4_split,
+        unpack_int4_split,
+    )
+
+    rng = np.random.RandomState(3)
+    q = rng.randint(-7, 8, size=(48, 96)).astype(np.int8)
+    packed = pack_int4_split(jnp.asarray(q))
+    unpacked = np.asarray(unpack_int4_split(packed))
+    in_pad, out_pad = unpacked.shape
+    assert in_pad >= 48 and out_pad >= 96 and out_pad == packed.shape[-1] * 2
+    # logical channels live in the first `out` columns, padding is zero
+    np.testing.assert_array_equal(unpacked[:48, :96], q)
+    assert not unpacked[48:].any() and not unpacked[:, 96:].any()
+
+
+def test_int4_split_matmul_matches_dequant_oracle():
+    from distributed_llm_inference_tpu.ops.quant import quantize_int4_split
+
+    rng = np.random.RandomState(4)
+    w = rng.randn(64, 96).astype(np.float32)
+    x = rng.randn(5, 64).astype(np.float32)
+    qt = quantize_int4_split(jnp.asarray(w))
+    # oracle: dequantized int4 weights, plain matmul
+    from distributed_llm_inference_tpu.ops.quant_matmul import (
+        unpack_int4_split,
+    )
+
+    w4 = np.asarray(unpack_int4_split(qt.q)).astype(np.float32)
+    ref = x @ (w4[:64] * np.asarray(qt.full_scale(), np.float32))[:, :96]
+    out = matmul(jnp.asarray(x), qt)
+    assert out.shape == (5, 96)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_int4_split_matmul_many_rows_fallback_matches_kernel():
+    from distributed_llm_inference_tpu.ops.quant import quantize_int4_split
+
+    rng = np.random.RandomState(5)
+    w = rng.randn(32, 64).astype(np.float32)
+    qt = quantize_int4_split(jnp.asarray(w))
+    x_big = rng.randn(300, 32).astype(np.float32)      # XLA fallback path
+    out_big = np.asarray(matmul(jnp.asarray(x_big), qt))
+    # the same rows through the kernel path (<=256 rows) must agree
+    np.testing.assert_allclose(
+        np.asarray(matmul(jnp.asarray(x_big[:8]), qt)), out_big[:8],
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_int4_split_quantize_roundtrip_error():
+    from distributed_llm_inference_tpu.ops.quant import quantize_int4_split
+
+    rng = np.random.RandomState(6)
+    w = rng.randn(64, 64).astype(np.float32)
+    qt = quantize_int4_split(jnp.asarray(w))
+    from distributed_llm_inference_tpu.ops.quant_matmul import (
+        unpack_int4_split,
+    )
+
+    deq = (
+        np.asarray(unpack_int4_split(qt.q)).astype(np.float32)
+        * np.asarray(qt.full_scale(), np.float32)
+    )[:64, :64]
+    err = np.abs(deq - w).max() / np.abs(w).max()
+    assert err < 0.2  # 4-bit per-channel: coarse but bounded
+
+
+def test_engine_int4_split_on_dp_mesh():
+    """dp/ep-only meshes keep the split (Pallas) layout — the spec node's
+    static in/out dims must match the param's or shard_pytree raises."""
+    from distributed_llm_inference_tpu.ops.quant import QuantizedTensor4Split
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(
+            max_batch_size=2, prefill_buckets=(16,), max_seq_len=32,
+            quantization="int4",
+        ),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(dp=2),
+    )
+    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor4Split)
+    outs = eng.generate([[1, 2, 3], [4, 5]], SamplingOptions(max_new_tokens=4))
+    assert all(len(o) == 4 for o in outs)
+
+
+def test_engine_int4_tp_mesh_uses_grouped_layout():
+    """tp>1 serving falls back to the grouped XLA layout (the packed
+    half-split channel order does not column-shard)."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(
+            max_batch_size=2, prefill_buckets=(16,), max_seq_len=32,
+            quantization="int4",
+        ),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(tp=2),
+    )
+    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor4)
+    outs = eng.generate([[1, 2, 3]], SamplingOptions(max_new_tokens=4))
+    assert len(outs[0]) == 4

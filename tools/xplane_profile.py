@@ -1,97 +1,21 @@
-"""Minimal xplane.pb parser: aggregate TPU op durations from a jax trace."""
-import collections
+"""CLI: aggregate TPU op durations from a jax trace's xplane.pb.
+
+Thin wrapper over ``distributed_llm_inference_tpu.utils.xplane`` (the parser
+lives in the package so bench.py and tests can use it too).
+"""
+import os
 import sys
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-def read_varint(buf, i):
-    r = 0
-    s = 0
-    while True:
-        b = buf[i]
-        i += 1
-        r |= (b & 0x7F) << s
-        if not b & 0x80:
-            return r, i
-        s += 7
-
-
-def fields(buf):
-    i = 0
-    n = len(buf)
-    while i < n:
-        tag, i = read_varint(buf, i)
-        fnum, wt = tag >> 3, tag & 7
-        if wt == 0:
-            v, i = read_varint(buf, i)
-            yield fnum, v
-        elif wt == 2:
-            ln, i = read_varint(buf, i)
-            yield fnum, buf[i : i + ln]
-            i += ln
-        elif wt == 5:
-            yield fnum, buf[i : i + 4]
-            i += 4
-        elif wt == 1:
-            yield fnum, buf[i : i + 8]
-            i += 8
-        else:
-            raise ValueError(f"wire type {wt}")
+from distributed_llm_inference_tpu.utils.xplane import aggregate  # noqa: E402
 
 
 def parse(path, top=40):
-    space = open(path, "rb").read()
-    for fnum, plane_buf in fields(space):
-        if fnum != 1:
-            continue
-        name = None
-        meta = {}
-        lines = []
-        for pf, pv in fields(plane_buf):
-            if pf == 2 and isinstance(pv, bytes):
-                name = pv.decode(errors="replace")
-            elif pf == 4:  # event_metadata map entry
-                mid, mname = None, ""
-                for mf, mv in fields(pv):
-                    if mf == 1:
-                        mid = mv
-                    elif mf == 2:
-                        for ef, ev in fields(mv):
-                            if ef == 2 and isinstance(ev, bytes):
-                                mname = ev.decode(errors="replace")
-                meta[mid] = mname
-            elif pf == 3:
-                lines.append(pv)
-        if name != "/device:TPU:0":
-            continue
-        agg = collections.Counter()
-        cnt = collections.Counter()
-        for line_buf in lines:
-            lname = ""
-            evs = []
-            for lf, lv in fields(line_buf):
-                if lf == 2 and isinstance(lv, bytes):
-                    try:
-                        lname = lv.decode()
-                    except Exception:
-                        lname = repr(lv)
-                elif lf == 4:
-                    evs.append(lv)
-            if "Step" in lname or "Modules" in lname:
-                continue  # whole-program umbrella lines
-            for ev in evs:
-                mid, dur = None, 0
-                for ef, v in fields(ev):
-                    if ef == 1:
-                        mid = v
-                    elif ef == 3:
-                        dur = v
-                agg[meta.get(mid, f"id{mid}") ] += dur
-                cnt[meta.get(mid, f"id{mid}")] += 1
-        total = sum(agg.values())
-        print(f"line-total {total/1e9:.2f} ms over {sum(cnt.values())} events")
-        for nm, d in agg.most_common(top):
-            print(f"{d/1e9:9.3f} ms  x{cnt[nm]:<5} {nm[:120]}")
-        return
+    total, agg, cnt = aggregate(path)
+    print(f"line-total {total/1e9:.2f} ms over {sum(cnt.values())} events")
+    for nm, d in agg.most_common(top):
+        print(f"{d/1e9:9.3f} ms  x{cnt[nm]:<5} {nm[:120]}")
 
 
 if __name__ == "__main__":
