@@ -80,6 +80,26 @@ def _tail_flush_rows(big, tail, lengths, tail_len, axis):
     return out.reshape(big.shape)
 
 
+def segment_valids(base_len, tail_len, num_new, t, kk, sliding_window):
+    """Validity masks ``([B, T], [B, K])`` for the (big, tail) segments of
+    the fused decode — shared by the bf16/int8 dense ``tail_attend`` and the
+    gathered paged tail so the window/validity rules cannot diverge."""
+    q_pos = base_len + tail_len
+    big_pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    big_valid = big_pos < base_len[:, None]
+    tail_pos = (
+        base_len[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+    )
+    tail_valid = (
+        jnp.arange(kk, dtype=jnp.int32)[None, :]
+        < (tail_len + num_new)[:, None]
+    )
+    if sliding_window is not None:
+        big_valid &= big_pos > (q_pos[:, None] - sliding_window)
+        tail_valid &= tail_pos > (q_pos[:, None] - sliding_window)
+    return big_valid, tail_valid
+
+
 class _DenseRowsMixin(GatherAttendMixin):
     """Shared row bookkeeping for contiguous per-row caches: absolute
     positions from ``lengths``, bucket-safe writes, causal masking, and
@@ -212,23 +232,8 @@ class _DenseRowsMixin(GatherAttendMixin):
 
     def _segment_valids(self, base_len, tail_len, num_new, t, kk,
                         sliding_window):
-        """Validity masks ``([B, T], [B, K])`` for the (big, tail) segments
-        of the fused decode — shared by the bf16 and int8 ``tail_attend``
-        so the window/validity rules cannot diverge."""
-        q_pos = base_len + tail_len
-        big_pos = jnp.arange(t, dtype=jnp.int32)[None, :]
-        big_valid = big_pos < base_len[:, None]
-        tail_pos = (
-            base_len[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
-        )
-        tail_valid = (
-            jnp.arange(kk, dtype=jnp.int32)[None, :]
-            < (tail_len + num_new)[:, None]
-        )
-        if sliding_window is not None:
-            big_valid &= big_pos > (q_pos[:, None] - sliding_window)
-            tail_valid &= tail_pos > (q_pos[:, None] - sliding_window)
-        return big_valid, tail_valid
+        return segment_valids(base_len, tail_len, num_new, t, kk,
+                              sliding_window)
 
 
 class DenseKVCache(_DenseRowsMixin, struct.PyTreeNode):

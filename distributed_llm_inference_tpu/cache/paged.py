@@ -634,63 +634,89 @@ class QuantizedPagedKVCache(PagedKVCache):
         return q_rot, k_all, v_all, mask, new
 
     # -- write-behind tail ----------------------------------------------------
+    #
+    # r3 redesign: the fused K-step window GATHERS each row's live pages to
+    # contiguous head-major buffers once (``tail_big_stacks``) and runs the
+    # same two-segment int8 attention as the quantized dense cache. The
+    # previous design read pages in place via the Pallas kernel per layer per
+    # step, but (profiled, b64 7B) the per-layer pool slices the scan feeds a
+    # kernel operand MATERIALIZE a full pool copy each (~9.6 ms/step of pure
+    # copies) and the per-page kernel grid pays ~3x the dense attention in
+    # fixed per-step cost. Amortized over K steps the gather is ~2% of a
+    # step; the pool itself stays read-only until ``tail_flush`` scatters the
+    # window back.
+
+    def tail_big_stacks(self):
+        """Contiguous head-major gather of every row's table span:
+        ``(k [L,B,Hkv,Tmax,D] int8, v, ks [L,B,Hkv,Tmax] f32, vs)``. Unmapped
+        table slots read the null page — masked by ``pos < base_len``."""
+        table = self.page_table  # [B, T]
+
+        def g(pages):  # [L, P, H, PS, D] → [L, B, H, T*PS, D]
+            v = jnp.take(pages, table, axis=1)       # [L, B, T, H, PS, D]
+            v = v.transpose(0, 1, 3, 2, 4, 5)        # [L, B, H, T, PS, D]
+            l, b, h, t, ps, d = v.shape
+            return v.reshape(l, b, h, t * ps, d)
+
+        def gs(scales):  # [L, P, H, PS] → [L, B, H, T*PS]
+            v = jnp.take(scales, table, axis=1).transpose(0, 1, 3, 2, 4)
+            l, b, h, t, ps = v.shape
+            return v.reshape(l, b, h, t * ps)
+
+        return (
+            g(self.k_pages), g(self.v_pages),
+            gs(self.ks_pages), gs(self.vs_pages),
+        )
 
     def tail_init(self, k_steps: int):
         l = self.k_pages.shape[0]
         b = self.page_table.shape[0]
         hkv, d = self.k_pages.shape[2], self.k_pages.shape[4]
-        z = jnp.zeros((l, b, k_steps, hkv, d), jnp.bfloat16)
+        # bf16 head-major tail (quantized into pages only at flush, exactly
+        # like the per-step path quantizes on write — pool contents match).
+        z = jnp.zeros((l, b, hkv, k_steps, d), jnp.bfloat16)
         return (z, z)
 
     def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
                     base_len, tail_len, step_idx, num_new, sliding_window,
                     scale=None):
-        from ..ops.attention import merge_softmax_segments
-        from ..ops.paged_attention import quantized_paged_attention
+        from ..ops.attention import gqa_attention_quantized_segments
+        from .dense import segment_valids
 
-        pool_k, pool_v, pool_ks, pool_vs = big_state
-        tk, tv = tail_state
+        gk, gv, gks, gvs = big_state   # [B, Hkv, Tmax, D] int8 / f32 scales
+        tk, tv = tail_state            # [B, Hkv, K, D] bf16
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
         tk = jax.lax.dynamic_update_slice_in_dim(
-            tk, k_rot.astype(tk.dtype), step_idx, axis=1
+            tk, jnp.moveaxis(k_rot, 1, 2).astype(tk.dtype), step_idx, axis=2
         )
         tv = jax.lax.dynamic_update_slice_in_dim(
-            tv, v_new.astype(tv.dtype), step_idx, axis=1
+            tv, jnp.moveaxis(v_new, 1, 2).astype(tv.dtype), step_idx, axis=2
         )
-
-        q_pos = base_len + tail_len
-        out_pool, m_pool, l_pool = quantized_paged_attention(
-            q_rot, pool_k, pool_ks, pool_v, pool_vs, self.page_table,
-            base_len, scale=scale, sliding_window=sliding_window,
-            q_positions=q_pos, return_stats=True,
+        big_valid, tail_valid = segment_valids(
+            base_len, tail_len, num_new, gk.shape[2], tk.shape[2],
+            sliding_window,
         )
-        kk = tk.shape[1]
-        tail_pos = (
-            base_len[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
-        )
-        tail_valid = (
-            jnp.arange(kk, dtype=jnp.int32)[None, :]
-            < (tail_len + num_new)[:, None]
-        )
-        if sliding_window is not None:
-            tail_valid &= tail_pos > (q_pos[:, None] - sliding_window)
-        out = merge_softmax_segments(
-            q_rot, out_pool, m_pool, l_pool,
-            tk.astype(q.dtype), tv.astype(q.dtype), tail_valid, scale,
+        ones = jnp.ones(tk.shape[:3], jnp.float32)
+        out = gqa_attention_quantized_segments(
+            q_rot,
+            [(gk, gks, gv, gvs, big_valid), (tk, ones, tv, ones, tail_valid)],
+            scale,
         )
         return out, (tk, tv)
 
     def tail_flush(self, tail, tail_len):
-        wk, wv = tail  # [L, B, K, Hkv, D] bf16 (keys already rotated)
-        kk = wk.shape[2]
+        wk, wv = tail  # [L, B, Hkv, K, D] bf16 (keys already rotated)
+        kk = wk.shape[3]
         q_pos = (
             self.lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
         )
         num_new = tail_len
         new_k, new_v, new_ks, new_vs = jax.vmap(
             lambda lk, lv, lks, lvs, tkl, tvl: self._scatter_q(
-                lk, lv, lks, lvs, tkl, tvl, q_pos, num_new
+                lk, lv, lks, lvs,
+                jnp.moveaxis(tkl, 1, 2), jnp.moveaxis(tvl, 1, 2),
+                q_pos, num_new,
             )
         )(self.k_pages, self.v_pages, self.ks_pages, self.vs_pages, wk, wv)
         return self.replace(

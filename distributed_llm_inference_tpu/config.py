@@ -198,5 +198,13 @@ class EngineConfig:
     # instead of chunked single-device prefill. None = the largest prefill
     # bucket.
     ring_prefill_threshold: Optional[int] = None
+    # Pipelined decode ticks (dense caches, fused decode, no draft): each
+    # step() dispatches the next K-step tick from a DEVICE-resident token
+    # carry before resolving the previous tick's tokens, so consecutive
+    # device steps chain with no host round trip between them (the fetch
+    # overlaps the next tick's compute). Token streams are identical; events
+    # for a tick arrive one step() later. Budgets are computed conservatively
+    # against the in-flight tick so no rollback is ever needed.
+    pipelined_ticks: bool = True
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled

@@ -306,14 +306,19 @@ class InferenceEngine:
 
         # The write-behind tail composes with tp/ep/dp sharding (its scalar
         # slot writes and flush gather partition) but not with the staged
-        # pipeline program, which pp engines use per step instead. The paged
-        # cache's tail path requires the Pallas kernel (the XLA fallback's
-        # per-step page gather is the materialization the tail avoids).
+        # pipeline program, which pp engines use per step instead. The int8
+        # paged cache's tail gathers its pool once per fused window (pure
+        # XLA); the bf16 paged tail still reads pages in place and requires
+        # the Pallas kernel.
         tail_capable = (
             attention is None
             and not self._use_pp
             and (
-                isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
+                isinstance(
+                    self.cache,
+                    (DenseKVCache, QuantizedDenseKVCache,
+                     QuantizedPagedKVCache),
+                )
                 or (
                     isinstance(self.cache, PagedKVCache)
                     and self.cache.use_kernel
@@ -374,6 +379,31 @@ class InferenceEngine:
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
         self._decode_k = self._with_mesh(jax.jit(_decode_scan, **dk))
+
+        # -- pipelined decode ticks -------------------------------------------
+        # Dispatch tick N from a device-resident carry of tick N-1's final
+        # tokens, THEN resolve tick N-1's emitted tokens (the host copy
+        # overlaps tick N's compute). On tunneled hardware the per-tick
+        # host round trip otherwise costs ~35% of serving throughput
+        # (engine 1779 vs raw 2701 tok/s at the same b72 int8_kvq config).
+        self._pending = None
+        self._carry = None
+        self._carry_ok = np.zeros(self.batch, np.bool_)
+        self._pipelined = (
+            self.ecfg.pipelined_ticks
+            and K > 1
+            and isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
+            and draft is None
+        )
+
+        def _carry_combine(fresh, carry, use_carry):
+            return jnp.where(use_carry[:, None], carry, fresh)
+
+        def _carry_merge(em_last, old, act):
+            return jnp.where(act[:, None], em_last[:, None], old)
+
+        self._carry_combine = self._with_mesh(jax.jit(_carry_combine))
+        self._carry_merge = self._with_mesh(jax.jit(_carry_merge))
 
         # -- ring (sequence-parallel) prefill (SURVEY §5.7) -------------------
         self._ring_prefill = None
@@ -570,17 +600,31 @@ class InferenceEngine:
         """One scheduler tick: admit + decode. Returns
         ``[(generation_id, token, finished), …]`` events. ``token == -1``
         signals a finish without a new token (capacity rejection/exhaustion) —
-        streaming consumers must not append it."""
+        streaming consumers must not append it.
+
+        Pipelined engines (``EngineConfig.pipelined_ticks``) dispatch the
+        next device tick BEFORE resolving the previous one, so a tick's
+        tokens arrive one ``step()`` later than they were dispatched."""
         produced: List[Tuple[str, int, bool]] = []
         with self._lock:
-            self._admit(produced)
-            if any(slot is not None for slot in self.slots):
-                self._decode_tick(produced)
+            if self._pipelined:
+                prev = self._pending
+                self._pending = self._dispatch_tick(produced, prev)
+                self._resolve_pending(produced, prev)
+                self._admit(produced)
+            else:
+                self._admit(produced)
+                if any(slot is not None for slot in self.slots):
+                    self._decode_tick(produced)
         return produced
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self.waiting) or any(s is not None for s in self.slots)
+            return (
+                bool(self.waiting)
+                or any(s is not None for s in self.slots)
+                or self._pending is not None
+            )
 
     def generate(
         self,
@@ -850,6 +894,118 @@ class InferenceEngine:
             and s.options.speculative
             and s.options.temperature == 0.0
         )
+
+    # -- pipelined ticks ------------------------------------------------------
+
+    def _dispatch_tick(self, produced, prev):
+        """Enqueue the next fused K-step tick using the device-resident
+        token carry (tick N-1's final sampled tokens) — no host fetch on the
+        input path, so the device queue never drains between ticks. Returns
+        the new pending tuple (or None when nothing was dispatched).
+
+        Budgets are CONSERVATIVE: they assume the in-flight tick (``prev``)
+        delivers its full budget, so a session can never over-write its
+        ``max_new_tokens`` or the buffer; a row whose conservative budget
+        hits zero idles one tick (its state resolves next step) instead of
+        rolling anything back."""
+        K = max(1, self.decode_steps)
+        if prev is not None:
+            # A slot whose tenant changed since the in-flight tick was
+            # dispatched (finish → admit) must not be charged the previous
+            # tenant's pending budget.
+            pend_b = np.where(
+                np.array([g == pg for g, pg in zip(self.slots, prev[3])]),
+                prev[1], 0,
+            )
+        else:
+            pend_b = np.zeros((self.batch,), np.int32)
+        fresh = np.zeros((self.batch, 1), np.int32)
+        use_carry = np.zeros((self.batch,), np.bool_)
+        opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
+        budget = np.zeros((self.batch,), np.int32)
+        for slot, gid in enumerate(self.slots):
+            if gid is None:
+                continue
+            s = self.sessions[gid]
+            opts[slot] = s.options
+            fresh[slot, 0] = s.last_token
+            use_carry[slot] = self._carry_ok[slot]
+            pend = int(pend_b[slot])
+            if pend == 0 and s.total_len + 1 > self.ecfg.max_seq_len:
+                # Nothing in flight for this row and no room for one more
+                # token: the session ends here (mirrors the plain tick).
+                self._finish(s, "capacity", produced)
+                continue
+            budget[slot] = max(0, min(
+                K,
+                s.options.max_new_tokens - len(s.generated) - pend,
+                self.ecfg.max_seq_len - s.total_len - pend,
+            ))
+        active = np.array(
+            [g is not None for g in self.slots], np.bool_
+        ) & (budget > 0)
+        if not active.any():
+            return None
+        if self._windows:
+            self._ensure_capacity(max(
+                self.sessions[g].total_len + int(pend_b[i]) + int(budget[i])
+                for i, g in enumerate(self.slots) if g is not None
+            ))
+        sp = SamplingParams.stack(opts)
+        eos_ids = np.asarray([o.eos_token_id for o in opts], np.int32)
+        if self._carry is None:
+            tokens_dev = jnp.asarray(fresh)
+        else:
+            tokens_dev = self._carry_combine(
+                jnp.asarray(fresh), self._carry, jnp.asarray(use_carry)
+            )
+        act_dev = jnp.asarray(active)
+        with self.metrics.timer("decode_step"), span(
+            "decode_step", self.spans, batch=int(active.sum()),
+        ):
+            emitted, self.cache = self._decode_k(
+                self.params, tokens_dev, self.cache, act_dev,
+                self._next_key(), sp, jnp.asarray(eos_ids),
+                jnp.asarray(budget),
+            )
+        old = (
+            self._carry if self._carry is not None
+            else jnp.zeros((self.batch, 1), jnp.int32)
+        )
+        self._carry = self._carry_merge(emitted[-1], old, act_dev)
+        self._carry_ok = self._carry_ok | active
+        return (emitted, budget, active, list(self.slots))
+
+    def _resolve_pending(self, produced, prev) -> None:
+        """Fetch and deliver the PREVIOUS tick's tokens (the copy overlaps
+        the tick just dispatched). Rows that stopped mid-tick but keep
+        serving (budget exhaustion) get their device carry invalidated —
+        the next dispatch feeds them the host-known last token instead."""
+        if prev is None:
+            return
+        emitted_dev, budget, active, gids = prev
+        with self.metrics.timer("decode_resolve"):
+            emitted = np.asarray(jax.device_get(emitted_dev))
+        delivered_total = 0
+        for slot, gid in enumerate(gids):
+            if gid is None or not active[slot]:
+                continue
+            s = self.sessions.get(gid)
+            if s is None or self.slots[slot] != gid:
+                continue  # cancelled/reaped since dispatch
+            delivered = 0
+            for i in range(int(budget[slot])):
+                if s.state != SessionState.ACTIVE:
+                    break
+                tok = int(emitted[i, slot])
+                if tok == -1:  # in-graph stop on an earlier step
+                    break
+                self._deliver(s, tok, produced)
+                delivered += 1
+            delivered_total += delivered
+            if delivered < int(budget[slot]) and s.state == SessionState.ACTIVE:
+                self._carry_ok[slot] = False
+        self.metrics.counter("decode_tokens", delivered_total)
 
     def _decode_tick(self, produced) -> None:
         if self.draft is not None and any(
@@ -1151,6 +1307,9 @@ class InferenceEngine:
     def _release(self, s: Session) -> None:
         if s.slot is not None:
             self.slots[s.slot] = None
+            # The device carry holds THIS session's last token; the slot's
+            # next tenant must be fed its own fresh token.
+            self._carry_ok[s.slot] = False
             s.slot = None
         if isinstance(self.cache, PagedKVCache) and s.pages:
             if self.ccfg.prefix_caching:

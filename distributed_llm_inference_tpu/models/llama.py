@@ -319,7 +319,16 @@ def multi_decode_apply(
     to per-step ``model_apply`` for other caches.
     """
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    big_stacks = cache.layer_stacks
+    # ``tail_big_stacks`` lets a cache hand the scan a DIFFERENT read-only
+    # view of its big planes than its storage layout — the quantized paged
+    # cache gathers its page pool to contiguous per-row buffers ONCE here
+    # (per-layer pool slices feeding a kernel materialize a full pool copy
+    # per layer per step; the gather amortizes to ~2% of a step over K).
+    big_stacks = (
+        cache.tail_big_stacks()
+        if hasattr(cache, "tail_big_stacks")
+        else cache.layer_stacks
+    )
     num_big = len(big_stacks)
     num_stack = big_stacks[0].shape[0]
     base_len = cache.lengths
