@@ -39,6 +39,13 @@ from ..ops.rotary import RopeAngles, apply_rope
 from .base import GatherAttendMixin
 
 
+@jax.jit
+def _table_write(table, pages_row, row, start):
+    """One cached executable for every page-table install (per pages-row
+    length): see :meth:`PagedKVCache.assign_pages`."""
+    return jax.lax.dynamic_update_slice(table, pages_row, (row, start))
+
+
 class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     k_pages: jax.Array
     v_pages: jax.Array
@@ -346,11 +353,17 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         )
 
     def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
-        """Host-side helper: install allocator-chosen page ids for a row."""
+        """Host-side helper: install allocator-chosen page ids for a row.
+
+        ``row``/``start_slot`` go in TRACED (via the jitted helper): baked-in
+        constants would compile a fresh executable per (row, slot) pair —
+        measured as a ~2 s stall the first time a serving tick crosses a page
+        boundary (one tiny compile per growing row)."""
         pages = jnp.asarray(pages, jnp.int32)
         return self.replace(
-            page_table=jax.lax.dynamic_update_slice(
-                self.page_table, pages[None, :], (row, start_slot)
+            page_table=_table_write(
+                self.page_table, pages[None, :], jnp.int32(row),
+                jnp.int32(start_slot),
             )
         )
 

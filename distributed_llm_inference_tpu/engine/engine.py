@@ -179,6 +179,7 @@ class InferenceEngine:
                 use_kernel=self.ecfg.use_pallas_attention,
             )
             self.allocator = PageAllocator(cc.num_pages)
+            self._warm_table_write()
         elif cc.kind == "sink":
             self.cache = SinkKVCache.create(
                 cfg.num_layers, b, cc.window_length, cc.num_sink_tokens,
@@ -236,6 +237,7 @@ class InferenceEngine:
             self.cache = shard_pytree(
                 self.cache, self.mesh, self._cache_pspecs(self.cache)
             )
+            self._warm_table_write()  # sharded table → new executable
 
         self.sessions: Dict[str, Session] = {}
         self.waiting: collections.deque[Session] = collections.deque()
@@ -389,10 +391,13 @@ class InferenceEngine:
         self._pending = None
         self._carry = None
         self._carry_ok = np.zeros(self.batch, np.bool_)
+        # Any tail-capable cache pipelines (dense kinds and the paged pools'
+        # fused windows); the sink ring (no tail) and draft-model engines
+        # keep the synchronous flow.
         self._pipelined = (
             self.ecfg.pipelined_ticks
             and K > 1
-            and isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
+            and tail_capable
             and draft is None
         )
 
@@ -482,12 +487,20 @@ class InferenceEngine:
                 )
                 return dcache
 
-            def _verify(params_, seq, cache, num_new, key, sp):
+            def _verify(params_, tokens, prop, spec_mask, cache, num_new,
+                        key, sp):
                 """One target forward over [last, p1..pk] (speculative rows,
-                num_new=k+1) and [last, pad…] (normal rows, num_new=1).
-                Returns per-position argmax (acceptance), the position-0
-                sample (normal rows' token), and the cache (advanced
-                per-row; the caller rolls speculative rows back)."""
+                num_new=k+1) and [last, pad…] (normal rows, num_new=1). The
+                verify sequence is built IN-GRAPH from the draft's proposals
+                so the host never has to fetch them before dispatching —
+                the proposal copy overlaps the verify compute. Returns
+                per-position argmax (acceptance), the position-0 sample
+                (normal rows' token), and the cache (advanced per-row; the
+                caller rolls speculative rows back)."""
+                seq = jnp.concatenate(
+                    [tokens, jnp.where(spec_mask[:, None], prop.T, 0)],
+                    axis=1,
+                )
                 logits, cache = llama.model_apply(
                     cfg, params_, seq, cache, num_new, **batch_mkw
                 )
@@ -498,7 +511,10 @@ class InferenceEngine:
             self._draft_prefill = jax.jit(_draft_prefill_row, **dk)
             self._draft_propose = jax.jit(_draft_propose, **dk)
             self._draft_catchup = jax.jit(_draft_catchup, **dk)
-            self._verify = self._with_mesh(jax.jit(_verify, **dk))
+            # Donate the CACHE (position 4 in the new signature — NOT the
+            # proposals, which the host fetches after dispatch).
+            vdk = dict(donate_argnums=(4,)) if donate else {}
+            self._verify = self._with_mesh(jax.jit(_verify, **vdk))
 
     def _window_ladder(
         self, cap: Optional[int] = None, strict: bool = True
@@ -533,6 +549,7 @@ class InferenceEngine:
                     self.cache.page_table, ((0, 0), (0, pad))
                 ))
                 self._reshard_cache()
+                self._warm_table_write()  # new table shape → new executable
                 self.metrics.counter("cache_growths")
             return
         if not isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache)):
@@ -544,6 +561,19 @@ class InferenceEngine:
         self.cache = self.cache.grow_to(new_t)
         self._reshard_cache()
         self.metrics.counter("cache_growths")
+
+    def _warm_table_write(self) -> None:
+        """Pre-compile the page-table install for the CURRENT table
+        shape/sharding (a null-page write over slot (0, 0) — already 0, and
+        every row's table is reset at admission anyway). Remote compiles
+        cost seconds on this platform; without this the first mid-serving
+        page growth after creation, a table widen, or a re-shard stalls a
+        decode tick."""
+        if isinstance(self.cache, PagedKVCache):
+            # DISCARD the result: we only want the executable compiled; the
+            # write itself would stomp a live row's first page mapping when
+            # re-warming after a mid-serving table widen.
+            self.cache.assign_pages(0, [0])
 
     def _reshard_cache(self) -> None:
         """Re-apply the mesh shardings after a growth/shrink re-created the
@@ -923,6 +953,7 @@ class InferenceEngine:
         use_carry = np.zeros((self.batch,), np.bool_)
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
         budget = np.zeros((self.batch,), np.int32)
+        paged = isinstance(self.cache, PagedKVCache)
         for slot, gid in enumerate(self.slots):
             if gid is None:
                 continue
@@ -931,15 +962,28 @@ class InferenceEngine:
             fresh[slot, 0] = s.last_token
             use_carry[slot] = self._carry_ok[slot]
             pend = int(pend_b[slot])
-            if pend == 0 and s.total_len + 1 > self.ecfg.max_seq_len:
-                # Nothing in flight for this row and no room for one more
-                # token: the session ends here (mirrors the plain tick).
-                self._finish(s, "capacity", produced)
-                continue
+            cap = (
+                self.ecfg.max_seq_len if not paged
+                else len(s.pages) * self.ccfg.page_size
+            )
+            if pend == 0 and s.total_len + 1 > cap:
+                if paged:
+                    # One more growth attempt before declaring capacity.
+                    cap = self._grow_pages(s, 1)
+                if s.total_len + 1 > cap:
+                    # Nothing in flight for this row and no room for one
+                    # more token: the session ends here (plain-tick rule).
+                    self._finish(s, "capacity", produced)
+                    continue
+            desired = max(0, min(
+                K, s.options.max_new_tokens - len(s.generated) - pend
+            ))
+            if paged and desired > 0:
+                # Conservative: pages must cover the in-flight tick's
+                # budget AND this one.
+                cap = self._grow_pages(s, pend + desired)
             budget[slot] = max(0, min(
-                K,
-                s.options.max_new_tokens - len(s.generated) - pend,
-                self.ecfg.max_seq_len - s.total_len - pend,
+                desired, cap - s.total_len - pend,
             ))
         active = np.array(
             [g is not None for g in self.slots], np.bool_
@@ -1105,11 +1149,11 @@ class InferenceEngine:
                 delivered += 1
         self.metrics.counter("decode_tokens", delivered)
 
-    def _grow_pages_for(self, s: Session, want: int, produced) -> Optional[int]:
-        """Grow ``s``'s page run to cover ``want`` more tokens (best effort);
-        returns the mapped capacity, or None if the session was finished for
-        lacking room for even one token. Shared by the plain and speculative
-        ticks so the table-widen-before-assign invariant lives once."""
+    def _grow_pages(self, s: Session, want: int) -> int:
+        """Grow ``s``'s page run to cover ``want`` more tokens (best
+        effort); returns the mapped capacity. Shared by the plain,
+        speculative, and pipelined ticks so the table-widen-before-assign
+        invariant lives once."""
         ps = self.ccfg.page_size
         while len(s.pages) * ps < s.total_len + want:
             if (
@@ -1125,7 +1169,12 @@ class InferenceEngine:
                 s.slot, new, start_slot=len(s.pages)
             )
             s.pages.extend(new)
-        cap = len(s.pages) * ps
+        return len(s.pages) * ps
+
+    def _grow_pages_for(self, s: Session, want: int, produced) -> Optional[int]:
+        """:meth:`_grow_pages` plus the synchronous ticks' rule: a session
+        without room for even one more token finishes (capacity)."""
+        cap = self._grow_pages(s, want)
         if s.total_len + 1 > cap:
             self._finish(s, "capacity", produced)
             return None
@@ -1194,16 +1243,12 @@ class InferenceEngine:
                 dparams, jnp.asarray(tokens), self.draft_cache,
                 jnp.asarray(active & spec),
             )
-            prop = np.asarray(jax.device_get(prop_d)).T  # [B, k]
         else:
             # Every speculative row was capacity-disabled this tick: skip
             # the k draft forwards (the verify below degrades to a plain
             # batched decode with k unused positions).
-            prop = np.zeros((b, k), np.int32)
+            prop_d = jnp.zeros((k, b), jnp.int32)
 
-        seq = np.zeros((b, k + 1), np.int32)
-        seq[:, 0] = tokens[:, 0]
-        seq[:, 1:] = np.where(spec[:, None], prop, 0)
         num_new = np.where(active, np.where(spec, k + 1, 1), 0).astype(
             np.int32
         )
@@ -1212,9 +1257,12 @@ class InferenceEngine:
             "speculative_step", self.spans, batch=int(active.sum()),
         ):
             preds_d, sampled_d, self.cache = self._verify(
-                self.params, jnp.asarray(seq), self.cache,
-                jnp.asarray(num_new), self._next_key(), sp,
+                self.params, jnp.asarray(tokens), prop_d, jnp.asarray(spec),
+                self.cache, jnp.asarray(num_new), self._next_key(), sp,
             )
+        # Fetch the proposals AFTER dispatching verify: the copy overlaps
+        # the target's k+1-position forward instead of serializing before it.
+        prop = np.asarray(jax.device_get(prop_d)).T  # [B, k]
         preds = np.asarray(jax.device_get(preds_d))
         sampled = np.asarray(jax.device_get(sampled_d))
 

@@ -652,3 +652,22 @@ def test_pipelined_matches_sync_mixed_sessions():
     assert (
         mk(True).generate(ps, eos_opts) == mk(False).generate(ps, eos_opts)
     )
+
+
+def test_pipelined_paged_matches_sync():
+    """Paged engines pipeline too (conservative page growth against the
+    in-flight tick): token-exact vs the synchronous flow, pages reclaimed."""
+    ps = prompts(6, lo=3, hi=12, seed=41)
+    opts = SamplingOptions(max_new_tokens=11)
+    mk = lambda pipelined: InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=3, prefill_buckets=(8, 16), max_seq_len=48,
+                     dtype="float32", pipelined_ticks=pipelined),
+        CacheConfig(kind="paged", kv_quant="int8", page_size=8, num_pages=64,
+                    max_pages_per_session=6),
+    )
+    ref = mk(False).generate(ps, opts)
+    eng = mk(True)
+    assert eng._pipelined
+    assert eng.generate(ps, opts) == ref
+    assert eng.allocator.free_count == 63  # all pages back (minus null page)
