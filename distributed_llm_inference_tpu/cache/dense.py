@@ -433,6 +433,14 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         return self.k.shape[3]
 
     @property
+    def tail_reads_whole_big(self) -> bool:
+        """Fused decode passes the big K/V stacks UNSLICED (plus a layer
+        index) so the Pallas kernel reads the cache in place — slicing a
+        layer out of the stack to feed a custom call copies it through HBM
+        every (layer, step), which measured ~3x decode cost at batch 112."""
+        return self.use_kernel
+
+    @property
     def layer_stacks(self):
         return (self.k, self.v, self.ks, self.vs)
 
@@ -613,7 +621,7 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         ``step_idx``."""
         from ..ops.attention import gqa_attention_quantized_segments
 
-        big_k, big_v, big_ks, big_vs = big_state
+        big_k, big_v, big_ks, big_vs = big_state[:4]
         tk, tv, tks, tvs = tail_state
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
@@ -632,18 +640,52 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
             tvs, jnp.moveaxis(v_s, 1, 2), step_idx, axis=2
         )
 
-        big_valid, tail_valid = self._segment_valids(
-            base_len, tail_len, num_new, big_k.shape[2], tk.shape[2],
+        # NOTE: in kernel (whole-stack) mode ``big_k`` is the UNSLICED
+        # [L, B, Hkv, T, D] stack, so a big-segment mask built from its
+        # axis 2 would be wrong — the kernel derives big validity from
+        # ``base_len``/``q_positions`` itself; only ``tail_valid`` is used.
+        _, tail_valid = self._segment_valids(
+            base_len, tail_len, num_new, big_k.shape[-2], tk.shape[2],
             sliding_window,
         )
-        out = gqa_attention_quantized_segments(
-            q_rot,
-            [
-                (big_k, big_ks, big_v, big_vs, big_valid),
-                (tk, tks, tv, tvs, tail_valid),
-            ],
-            scale,
-        )
+        if self.use_kernel and q.shape[1] == 1:
+            # Big read-only segment through the Pallas kernel (int8 streams
+            # through VMEM once, near HBM roofline — the XLA segments path
+            # measured ~2.3x the segment's byte cost at batch 112); the
+            # K-token tail is tiny, so it dequantizes in XLA and joins via
+            # an exact online-softmax merge. In whole-stack mode (see
+            # ``tail_reads_whole_big``) the big state carries the UNSLICED
+            # ``[L, ...]`` buffers plus the layer index, so the kernel reads
+            # the cache in place with no per-layer slice copy.
+            from ..ops.attention import merge_softmax_segments_quantized
+            from ..ops.quant_attention import (
+                quantized_decode_attention_stacked,
+            )
+
+            # Whole-stack mode is implied: ``tail_reads_whole_big`` is true
+            # exactly when ``use_kernel`` is, so ``multi_decode_apply``
+            # always hands this branch (k, v, ks, vs, layer_idx).
+            out_b, m_b, l_b = quantized_decode_attention_stacked(
+                q_rot, big_k, big_ks, big_v, big_vs, big_state[4],
+                base_len, scale, sliding_window,
+                q_positions=base_len + tail_len,
+            )
+            out = merge_softmax_segments_quantized(
+                q_rot, out_b, m_b, l_b, tk, tks, tv, tvs, tail_valid, scale
+            )
+        else:
+            big_valid, _ = self._segment_valids(
+                base_len, tail_len, num_new, big_k.shape[2], tk.shape[2],
+                sliding_window,
+            )
+            out = gqa_attention_quantized_segments(
+                q_rot,
+                [
+                    (big_k, big_ks, big_v, big_vs, big_valid),
+                    (tk, tks, tv, tvs, tail_valid),
+                ],
+                scale,
+            )
         return out, (tk, tv, tks, tvs)
 
     def tail_flush(self, tail, tail_len):

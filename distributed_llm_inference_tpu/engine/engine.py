@@ -809,7 +809,15 @@ class InferenceEngine:
                         self.allocator.free(shared)  # return the refs
                     break  # pool pressure: hold the queue, retry next tick
                 s.pages = shared + self.allocator.alloc(need - len(shared))
-                self.cache = self.cache.assign_pages(slot, s.pages)
+                # One page per install: reuses the 1-page executable
+                # ``_warm_table_write`` pre-compiled. A whole-run install
+                # compiles a fresh executable per distinct prompt page
+                # count — a ~2 s remote-compile stall per new length the
+                # first time it admits.
+                for i, pg in enumerate(s.pages):
+                    self.cache = self.cache.assign_pages(
+                        slot, [pg], start_slot=i
+                    )
                 shared_len = len(shared) * ps
                 if shared_len:
                     self.cache = self.cache.replace(

@@ -332,11 +332,18 @@ def multi_decode_apply(
     num_big = len(big_stacks)
     num_stack = big_stacks[0].shape[0]
     base_len = cache.lengths
+    # Whole-stack mode (Pallas big-segment kernels): the big buffers are NOT
+    # sliced per layer — a dynamic-slice feeding a custom call materializes a
+    # full HBM copy of that layer's K/V every (layer, step). Instead the
+    # stacks pass through whole with the layer index appended; the kernel's
+    # block index map resolves the layer, so the operand is zero-copy.
+    whole_big = getattr(cache, "tail_reads_whole_big", False)
+    view_num_big = num_big + 1 if whole_big else num_big
 
     def token_step(carry, i):
         tokens, tail, tail_len, num_new, state = carry
         x = jnp.take(params["embed"], tokens, axis=0)
-        view = _TailView(cache, base_len, tail_len, i, num_big)
+        view = _TailView(cache, base_len, tail_len, i, view_num_big)
         q_pos = view.q_positions(1)
         cos, sin = rope_cos_sin(q_pos, inv_freq)
         rope = RopeAngles(inv_freq, cos, sin)
@@ -344,8 +351,11 @@ def multi_decode_apply(
         def layer_step(carry2, xs):
             x, tail_bufs = carry2
             p = xs[0]
-            big_state = tuple(xs[1 : 1 + num_big])
             idx = xs[-1]
+            if whole_big:
+                big_state = (*big_stacks, idx)
+            else:
+                big_state = tuple(xs[1 : 1 + num_big])
             tail_state = tuple(
                 jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
                 for b in tail_bufs
@@ -356,13 +366,15 @@ def multi_decode_apply(
             )
             tail_bufs = tuple(
                 jax.lax.dynamic_update_index_in_dim(b, n, idx, 0)
-                for b, n in zip(tail_bufs, new_state[num_big:])
+                for b, n in zip(tail_bufs, new_state[view_num_big:])
             )
             return (out, tail_bufs), None
 
         (x, tail), _ = jax.lax.scan(
             layer_step, (x, tail),
-            (params["layers"], *big_stacks, jnp.arange(num_stack)),
+            (params["layers"],
+             *(() if whole_big else big_stacks),
+             jnp.arange(num_stack)),
         )
         logits = apply_head(cfg, params, x)
         next_tokens, next_num_new, state, emit = step_fn(i, logits[:, 0], state)

@@ -41,23 +41,26 @@ __all__ = ["quantized_decode_attention"]
 
 def _qdense_kernel(
     len_ref,    # SMEM [B] int32 (scalar prefetch)
+    qpos_ref,   # SMEM [B] int32 (query positions, for the sliding window)
     q_ref,      # [1, Hkv, G, D]
     k_ref,      # [1, Hkv, BT, D] int8
     ks_ref,     # [1, Hkv, BT] f32
     v_ref,      # [1, Hkv, BT, D] int8
     vs_ref,     # [1, Hkv, BT] f32
-    out_ref,    # [1, Hkv, G, D]
-    acc_ref,    # VMEM [Hkv*G, D] f32
-    m_ref,      # VMEM [Hkv*G, 128] f32
-    l_ref,      # VMEM [Hkv*G, 128] f32
-    *,
+    *refs,      # out_ref [, m_out_ref, l_out_ref], acc_ref, m_ref, l_ref
     scale: float,
     block_t: int,
     num_blocks: int,
     sliding_window: Optional[int],
     hkv: int,
     g: int,
+    with_stats: bool,
 ):
+    if with_stats:
+        out_ref, m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        out_ref, acc_ref, m_ref, l_ref = refs
+        m_out_ref = l_out_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -71,7 +74,7 @@ def _qdense_kernel(
     pos = j * block_t + jax.lax.broadcasted_iota(jnp.int32, (1, block_t), 1)
     valid = pos < kv_len  # decode: causality ≡ slot validity
     if sliding_window is not None:
-        valid &= pos > kv_len - 1 - sliding_window
+        valid &= pos > qpos_ref[b] - sliding_window
 
     q = q_ref[0]                       # [Hkv, G, D]
     k = k_ref[0]                       # [Hkv, BT, D] int8
@@ -124,6 +127,9 @@ def _qdense_kernel(
         l = l_ref[:, :1]
         out = acc_ref[:] / jnp.maximum(l, 1e-20)
         out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
+        if with_stats:
+            m_out_ref[0] = m_ref[:]
+            l_out_ref[0] = l_ref[:]
 
 
 def quantized_decode_attention(
@@ -137,7 +143,9 @@ def quantized_decode_attention(
     sliding_window: Optional[int] = None,
     block_t: int = 128,
     interpret: Optional[bool] = None,
-) -> jnp.ndarray:
+    q_positions: Optional[jnp.ndarray] = None,
+    return_stats: bool = False,
+):
     """Decode attention straight over the int8 head-major dense cache.
 
     ``q``: ``[B, 1, Hq, D]`` (already rotated); ``k_q``/``v_q``: int8
@@ -145,6 +153,14 @@ def quantized_decode_attention(
     ``[B, Hkv, T]`` per-(token, head) scales; ``kv_lengths``: ``[B]`` live kv
     count per row *including* tokens written this step. Returns
     ``[B, 1, Hq, D]`` in q's dtype.
+
+    ``q_positions`` (``[B]``, default ``kv_lengths - 1``): the absolute
+    position of each row's query, which anchors the sliding window — the
+    fused-decode caller passes ``base_len + tail_len`` so the window stays
+    correct while the big segment is frozen at ``base_len``.
+    ``return_stats=True`` additionally returns the online-softmax stats
+    ``(m, l)`` as ``[B, Hkv, G]`` f32 for a joint merge with another segment
+    (``ops.attention.merge_softmax_segments``).
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -155,6 +171,8 @@ def quantized_decode_attention(
         scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if q_positions is None:
+        q_positions = kv_lengths - 1
     bt = min(block_t, t)
     num_blocks = -(-t // bt)
     if t % bt:
@@ -166,28 +184,50 @@ def quantized_decode_attention(
 
     qr = q.reshape(b, hkv, g, d)
 
-    def _tile_index(bi, ji, lens):
+    def _tile_index(bi, ji, lens, qpos):
         # Tiles past the row's live span clamp to tile 0 (one hot fetch).
         live = ji * bt < lens[bi]
         return (bi, 0, jnp.where(live, ji, 0), 0)
 
-    def _tile_index3(bi, ji, lens):
+    def _tile_index3(bi, ji, lens, qpos):
         live = ji * bt < lens[bi]
         return (bi, 0, jnp.where(live, ji, 0))
 
+    out_specs = [
+        pl.BlockSpec(
+            (1, hkv, g, d), lambda bi, ji, lens, qpos: (bi, 0, 0, 0)
+        ),
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype)]
+    if return_stats:
+        # m/l outputs exist only when a caller merges with another segment;
+        # the plain decode path skips them (2*B*Hkv*G*128*4 bytes of HBM
+        # writes per (layer, step) it would otherwise discard).
+        out_specs += [
+            pl.BlockSpec(
+                (1, hkv * g, 128), lambda bi, ji, lens, qpos: (bi, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, hkv * g, 128), lambda bi, ji, lens, qpos: (bi, 0, 0)
+            ),
+        ]
+        out_shapes += [
+            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(b, num_blocks),
         in_specs=[
-            pl.BlockSpec((1, hkv, g, d), lambda bi, ji, lens: (bi, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, hkv, g, d), lambda bi, ji, lens, qpos: (bi, 0, 0, 0)
+            ),
             pl.BlockSpec((1, hkv, bt, d), _tile_index),
             pl.BlockSpec((1, hkv, bt), _tile_index3),
             pl.BlockSpec((1, hkv, bt, d), _tile_index),
             pl.BlockSpec((1, hkv, bt), _tile_index3),
         ],
-        out_specs=pl.BlockSpec(
-            (1, hkv, g, d), lambda bi, ji, lens: (bi, 0, 0, 0)
-        ),
+        out_specs=tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((hkv * g, d), jnp.float32),
             pltpu.VMEM((hkv * g, 128), jnp.float32),
@@ -202,11 +242,261 @@ def quantized_decode_attention(
         sliding_window=sliding_window,
         hkv=hkv,
         g=g,
+        with_stats=return_stats,
     )
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        out_shape=tuple(out_shapes),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(kv_lengths.astype(jnp.int32), qr, k_q, ks, v_q, vs)
-    return out.reshape(b, 1, hq, d)
+    )(kv_lengths.astype(jnp.int32), q_positions.astype(jnp.int32),
+      qr, k_q, ks, v_q, vs)
+    if return_stats:
+        out, m, l = res
+        out = out.reshape(b, 1, hq, d)
+        return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)
+    return res[0].reshape(b, 1, hq, d)
+
+
+def quantized_decode_attention_stacked(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    ks: jnp.ndarray,
+    v_q: jnp.ndarray,
+    vs: jnp.ndarray,
+    layer_idx: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_t: int = 128,
+    block_b: int = 8,
+    interpret: Optional[bool] = None,
+    q_positions: Optional[jnp.ndarray] = None,
+):
+    """As :func:`quantized_decode_attention` + stats, but over the WHOLE
+    layer-stacked cache ``[L, B, Hkv, T, D]`` with a traced ``layer_idx``.
+
+    Two deliberate structural choices, both measured on v5e at batch 112
+    (Llama-7B shapes, fused 16-step decode):
+
+    * Zero-copy operands. Inside the fused decode's layer scan, slicing one
+      layer's K/V out of the stack to feed a ``pallas_call`` materializes a
+      full HBM copy of that layer's buffers every (layer, step) — XLA cannot
+      fuse a dynamic-slice into a custom call's operand (tripled decode
+      cost). The stack passes through whole; the block index map resolves
+      the traced ``layer_idx``.
+    * Row-blocked grid. One batch row per grid step (the natural port of the
+      per-row paged kernel) issues ~1 MB DMAs and its per-step overhead
+      dominates: measured 1.57 ms per (layer, step) vs the XLA segment
+      path's 0.42 ms. ``block_b`` rows per step turn that into ~8 MB DMAs
+      over an 8x smaller grid.
+
+    Always returns ``(out, m, l)`` (stats for the tail merge);
+    ``kv_lengths`` is per-row live length of the big segment, and
+    ``q_positions`` anchors the sliding window.
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode-only kernel (S=1), got S={s}")
+    num_l, _, hkv, t, _ = k_q.shape
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if q_positions is None:
+        q_positions = kv_lengths - 1
+    bt = min(block_t, t)
+    num_blocks = -(-t // bt)
+    nb = min(block_b, b)
+    num_row_blocks = -(-b // nb)
+    bp = num_row_blocks * nb
+    if bp != b:
+        # Pad the small per-row operands only (q/lengths); the KV stack is
+        # never padded — padding it would copy the multi-GB buffer inside
+        # the decode loop. Pad rows read KV tile 0 (masked: length 0).
+        q = jnp.pad(q, ((0, bp - b), (0, 0), (0, 0), (0, 0)))
+        kv_lengths = jnp.pad(kv_lengths, (0, bp - b))
+        q_positions = jnp.pad(q_positions, (0, bp - b))
+
+    qr = q.reshape(bp, hkv, g, d)
+    lref = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+
+    def _row_live(bi, ji, lens):
+        # A KV time-tile is fetched iff ANY row in this row-block still has
+        # live tokens there; otherwise clamp to tile 0 (the pipeline elides
+        # the repeat fetch). Padded rows have length 0, never forcing tiles.
+        # ``lens`` is an SMEM ref: scalar reads only, unrolled over the block.
+        live = ji * bt < lens[bi * nb]
+        for r in range(1, nb):
+            live |= ji * bt < lens[bi * nb + r]
+        return live
+
+    def _tile_index(bi, ji, lidx, lens, qpos):
+        return (lidx[0], bi, 0, jnp.where(_row_live(bi, ji, lens), ji, 0), 0)
+
+    def _tile_index3(bi, ji, lidx, lens, qpos):
+        return (lidx[0], bi, 0, jnp.where(_row_live(bi, ji, lens), ji, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(num_row_blocks, num_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (nb, hkv, g, d),
+                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0, 0),
+            ),
+            pl.BlockSpec((1, nb, hkv, bt, d), _tile_index),
+            pl.BlockSpec((1, nb, hkv, bt), _tile_index3),
+            pl.BlockSpec((1, nb, hkv, bt, d), _tile_index),
+            pl.BlockSpec((1, nb, hkv, bt), _tile_index3),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (nb, hkv, g, d),
+                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (nb, hkv * g, 128),
+                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0),
+            ),
+            pl.BlockSpec(
+                (nb, hkv * g, 128),
+                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0),
+            ),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((nb, hkv * g, d), jnp.float32),
+            pltpu.VMEM((nb, hkv * g, 128), jnp.float32),
+            pltpu.VMEM((nb, hkv * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _qdense_stacked_kernel,
+        scale=scale,
+        block_t=bt,
+        num_blocks=num_blocks,
+        sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
+        nb=nb,
+    )
+    out, m, l = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bp, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((bp, hkv * g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bp, hkv * g, 128), jnp.float32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            # Row blocks are independent; time-tiles carry the softmax
+            # scratch. The default 16 MB scoped-vmem budget rejects the
+            # double-buffered 4 MB K/V tiles, so raise it (v5e has 128 MB).
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(lref, kv_lengths.astype(jnp.int32), q_positions.astype(jnp.int32),
+      qr, k_q, ks, v_q, vs)
+    out = out[:b].reshape(b, 1, hq, d)
+    return (
+        out,
+        m[:b, :, 0].reshape(b, hkv, g),
+        l[:b, :, 0].reshape(b, hkv, g),
+    )
+
+
+def _qdense_stacked_kernel(
+    lidx_ref,   # SMEM [1] int32 (layer index; consumed by the index maps)
+    len_ref,    # SMEM [B] int32
+    qpos_ref,   # SMEM [B] int32
+    q_ref,      # [NB, Hkv, G, D]
+    k_ref,      # [1, NB, Hkv, BT, D] int8
+    ks_ref,     # [1, NB, Hkv, BT] f32
+    v_ref,      # [1, NB, Hkv, BT, D] int8
+    vs_ref,     # [1, NB, Hkv, BT] f32
+    out_ref,    # [NB, Hkv, G, D]
+    m_out_ref,  # [NB, Hkv*G, 128] f32
+    l_out_ref,  # [NB, Hkv*G, 128] f32
+    acc_ref,    # VMEM [NB, Hkv*G, D] f32
+    m_ref,      # VMEM [NB, Hkv*G, 128] f32
+    l_ref,      # VMEM [NB, Hkv*G, 128] f32
+    *,
+    scale: float,
+    block_t: int,
+    num_blocks: int,
+    sliding_window: Optional[int],
+    hkv: int,
+    g: int,
+    nb: int,
+):
+    """Row-blocked variant of :func:`_qdense_kernel`: NB batch rows per grid
+    step share one (much larger) KV DMA; online-softmax state carries a
+    leading row axis."""
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Per-row masks from SMEM scalars, unrolled over the row block (vector
+    # builds like ``.at[r].set`` lower to scatter, which Mosaic lacks).
+    pos = j * block_t + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_t), 1
+    )
+    row_valids = []
+    for r in range(nb):
+        vr = pos < len_ref[bi * nb + r]
+        if sliding_window is not None:
+            vr &= pos > qpos_ref[bi * nb + r] - sliding_window
+        row_valids.append(vr)
+    valid = jnp.stack(row_valids)              # [NB, 1, BT]
+
+    q = q_ref[:]                               # [NB, Hkv, G, D]
+    k = k_ref[0]                               # [NB, Hkv, BT, D] int8
+    ks = ks_ref[0]                             # [NB, Hkv, BT] f32
+
+    s = jax.lax.dot_general(
+        q.astype(jnp.bfloat16).reshape(nb * hkv, g, -1),
+        k.astype(jnp.bfloat16).reshape(nb * hkv, block_t, -1),
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).reshape(nb, hkv, g, block_t)     # bf16 MXU (Mosaic: one batch dim max)
+    s = s * ks[:, :, None, :]
+    s = (s * scale).reshape(nb, hkv * g, block_t)
+    s = jnp.where(valid, s, _NEG_INF)          # valid [NB, 1, BT] broadcasts
+
+    m_prev = m_ref[:, :, :1]                   # [NB, Hkv*G, 1]
+    l_prev = l_ref[:, :, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    v = v_ref[0]                               # [NB, Hkv, BT, D] int8
+    vs = vs_ref[0]                             # [NB, Hkv, BT] f32
+    pw = p.reshape(nb, hkv, g, block_t) * vs[:, :, None, :]
+    pv = jax.lax.dot_general(
+        pw.astype(jnp.bfloat16).reshape(nb * hkv, g, block_t),
+        v.astype(jnp.bfloat16).reshape(nb * hkv, block_t, -1),
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                          # [NB*Hkv, G, D]
+    acc_ref[:] = acc_ref[:] * alpha + pv.reshape(nb, hkv * g, -1)
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out_ref[:] = out.reshape(nb, hkv, g, -1).astype(out_ref.dtype)
+        m_out_ref[:] = m_ref[:]
+        l_out_ref[:] = l_ref[:]

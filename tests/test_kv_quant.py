@@ -122,6 +122,64 @@ def test_quantized_cache_sharded_matches_single_device():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("window", [None, 7, 3])
+def test_stacked_kernel_tail_merge_matches_segments(window):
+    """The fused-decode kernel path (whole-stack Pallas big segment +
+    quantized head-major tail merge) matches the XLA two-segment joint
+    softmax across sliding windows — locks in the ``q_positions`` window
+    anchor (the big segment is frozen at ``base_len`` while the query sits
+    at ``base_len + tail_len``)."""
+    from distributed_llm_inference_tpu.cache.dense import segment_valids
+    from distributed_llm_inference_tpu.ops.attention import (
+        gqa_attention_quantized_segments,
+        merge_softmax_segments_quantized,
+    )
+    from distributed_llm_inference_tpu.ops.quant_attention import (
+        quantized_decode_attention_stacked,
+    )
+
+    L, B, HKV, G, T, KT, D = 2, 3, 2, 2, 20, 4, 16
+    rng = jax.random.PRNGKey(3)
+    ks = jax.random.split(rng, 8)
+    q = jax.random.normal(ks[0], (B, 1, HKV * G, D), jnp.float32)
+    big_k = jax.random.randint(ks[1], (L, B, HKV, T, D), -127, 127, jnp.int8)
+    big_v = jax.random.randint(ks[2], (L, B, HKV, T, D), -127, 127, jnp.int8)
+    big_ks = jnp.abs(jax.random.normal(ks[3], (L, B, HKV, T))) * 0.02
+    big_vs = jnp.abs(jax.random.normal(ks[4], (L, B, HKV, T))) * 0.02
+    tk = jax.random.randint(ks[5], (B, HKV, KT, D), -127, 127, jnp.int8)
+    tv = jax.random.randint(ks[6], (B, HKV, KT, D), -127, 127, jnp.int8)
+    tks = jnp.abs(jax.random.normal(ks[7], (B, HKV, KT))) * 0.02
+    tvs = tks * 0.5 + 0.01
+    base_len = jnp.asarray([13, 20, 5], jnp.int32)
+    tail_len = jnp.asarray([2, 1, 0], jnp.int32)
+    num_new = jnp.ones((B,), jnp.int32)
+
+    big_valid, tail_valid = segment_valids(
+        base_len, tail_len, num_new, T, KT, window
+    )
+    for layer in range(L):
+        ref = gqa_attention_quantized_segments(
+            q,
+            [
+                (big_k[layer], big_ks[layer], big_v[layer], big_vs[layer],
+                 big_valid),
+                (tk, tks, tv, tvs, tail_valid),
+            ],
+        )
+        out_b, m_b, l_b = quantized_decode_attention_stacked(
+            q, big_k, big_ks, big_v, big_vs, jnp.int32(layer), base_len,
+            sliding_window=window, q_positions=base_len + tail_len,
+        )
+        out = merge_softmax_segments_quantized(
+            q, out_b, m_b, l_b, tk, tks, tv, tvs, tail_valid
+        )
+        # the kernel's dots run in bf16 (MXU-native); the XLA reference
+        # contracts in f32
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-2, atol=1e-2
+        )
+
+
 def test_quantized_pallas_kernel_engine_parity():
     """use_pallas_attention with kv_quant='int8' routes decode through the
     int8 VMEM-streaming kernel (interpret mode here) and matches the XLA
