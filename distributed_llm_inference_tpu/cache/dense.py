@@ -607,10 +607,27 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
 
     # -- write-behind tail (fused multi-step decode) --------------------------
 
+    @property
+    def tail_in_kernel(self) -> bool:
+        """Kernel mode handles the tail INSIDE the Pallas kernel: the whole
+        tail stacks pass through as io-aliased operands (no per-layer
+        slicing in the scan), the step's K/V quantize in-kernel, and the
+        tail is the final online-softmax tile."""
+        return self.use_kernel
+
     def tail_init(self, k_steps: int):
         l, b, h, t, d = self.k.shape
-        zq = jnp.zeros((l, b, h, k_steps, d), jnp.int8)
         zs = jnp.zeros((l, b, h, k_steps), jnp.float32)
+        if self.use_kernel:
+            # Distinct buffers: the fused kernel aliases each tail operand
+            # to an output; a shared k/v zeros array cannot be donated twice.
+            return (
+                jnp.zeros((l, b, h, k_steps, d), jnp.int8),
+                jnp.zeros((l, b, h, k_steps, d), jnp.int8),
+                zs,
+                jnp.zeros((l, b, h, k_steps), jnp.float32),
+            )
+        zq = jnp.zeros((l, b, h, k_steps, d), jnp.int8)
         return (zq, zq, zs, zs)
 
     def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
@@ -625,6 +642,28 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         tk, tv, tks, tvs = tail_state
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        if self.use_kernel and q.shape[1] == 1:
+            # Everything in ONE Pallas call: the step's K/V quantize
+            # in-kernel and land in the io-aliased whole-stack tail, and
+            # the tail joins the big sweep as the final online-softmax
+            # tile. XLA never touches the int8 planes (the XLA-side tail —
+            # quantize, 4 update-slices, einsums, merge — measured ~8
+            # ms/step at batch 112 under the custom call's layout
+            # constraints).
+            from ..ops.quant_attention import (
+                quantized_fused_decode_attention,
+            )
+
+            out, ntk, ntks, ntv, ntvs = quantized_fused_decode_attention(
+                q_rot, k_rot, v_new,
+                big_k, big_ks, big_v, big_vs,
+                tk, tks, tv, tvs,
+                layer_idx=big_state[4], step_idx=step_idx,
+                base_len=base_len, tail_valid_len=tail_len + num_new,
+                q_positions=base_len + tail_len,
+                scale=scale, sliding_window=sliding_window,
+            )
+            return out, (ntk, ntv, ntks, ntvs)
         k_q, k_s = _quantize_kv(k_rot)   # [B, 1, Hkv, D] / [B, 1, Hkv]
         v_q, v_s = _quantize_kv(v_new)
         tk = jax.lax.dynamic_update_slice_in_dim(
@@ -640,52 +679,18 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
             tvs, jnp.moveaxis(v_s, 1, 2), step_idx, axis=2
         )
 
-        # NOTE: in kernel (whole-stack) mode ``big_k`` is the UNSLICED
-        # [L, B, Hkv, T, D] stack, so a big-segment mask built from its
-        # axis 2 would be wrong — the kernel derives big validity from
-        # ``base_len``/``q_positions`` itself; only ``tail_valid`` is used.
-        _, tail_valid = self._segment_valids(
-            base_len, tail_len, num_new, big_k.shape[-2], tk.shape[2],
+        big_valid, tail_valid = self._segment_valids(
+            base_len, tail_len, num_new, big_k.shape[2], tk.shape[2],
             sliding_window,
         )
-        if self.use_kernel and q.shape[1] == 1:
-            # Big read-only segment through the Pallas kernel (int8 streams
-            # through VMEM once, near HBM roofline — the XLA segments path
-            # measured ~2.3x the segment's byte cost at batch 112); the
-            # K-token tail is tiny, so it dequantizes in XLA and joins via
-            # an exact online-softmax merge. In whole-stack mode (see
-            # ``tail_reads_whole_big``) the big state carries the UNSLICED
-            # ``[L, ...]`` buffers plus the layer index, so the kernel reads
-            # the cache in place with no per-layer slice copy.
-            from ..ops.attention import merge_softmax_segments_quantized
-            from ..ops.quant_attention import (
-                quantized_decode_attention_stacked,
-            )
-
-            # Whole-stack mode is implied: ``tail_reads_whole_big`` is true
-            # exactly when ``use_kernel`` is, so ``multi_decode_apply``
-            # always hands this branch (k, v, ks, vs, layer_idx).
-            out_b, m_b, l_b = quantized_decode_attention_stacked(
-                q_rot, big_k, big_ks, big_v, big_vs, big_state[4],
-                base_len, scale, sliding_window,
-                q_positions=base_len + tail_len,
-            )
-            out = merge_softmax_segments_quantized(
-                q_rot, out_b, m_b, l_b, tk, tks, tv, tvs, tail_valid, scale
-            )
-        else:
-            big_valid, _ = self._segment_valids(
-                base_len, tail_len, num_new, big_k.shape[2], tk.shape[2],
-                sliding_window,
-            )
-            out = gqa_attention_quantized_segments(
-                q_rot,
-                [
-                    (big_k, big_ks, big_v, big_vs, big_valid),
-                    (tk, tks, tv, tvs, tail_valid),
-                ],
-                scale,
-            )
+        out = gqa_attention_quantized_segments(
+            q_rot,
+            [
+                (big_k, big_ks, big_v, big_vs, big_valid),
+                (tk, tks, tv, tvs, tail_valid),
+            ],
+            scale,
+        )
         return out, (tk, tv, tks, tvs)
 
     def tail_flush(self, tail, tail_len):

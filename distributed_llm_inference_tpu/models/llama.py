@@ -165,6 +165,35 @@ def _decoder_layer(
     return x + mlp, new_state
 
 
+def _split_int4_stacks(layer_params: Params):
+    """Partition the layer dict: half-split int4 leaves are captured WHOLE
+    (their Pallas matmul indexes the layer in its block index map);
+    everything else rides the scan's xs and gets sliced for free. Slicing
+    an int4 stack per scan step would copy the layer's packed weight
+    through HBM before every kernel call — the copy traffic is why int4
+    decode measured slower than int8 before this split."""
+    from ..ops.quant import QuantizedTensor4Split
+
+    whole = {
+        k: v
+        for k, v in layer_params.items()
+        if isinstance(v, QuantizedTensor4Split)
+    }
+    scanned = {k: v for k, v in layer_params.items() if k not in whole}
+    return whole, scanned
+
+
+def _int4_views(whole: Params, idx) -> Params:
+    from ..ops.quant import QuantizedTensor4SplitView
+
+    return {
+        k: QuantizedTensor4SplitView(
+            v.q, v.scale_lo, v.scale_hi, idx, v.in_dim, v.out_dim
+        )
+        for k, v in whole.items()
+    }
+
+
 def block_apply(
     cfg: ModelConfig,
     layer_params: Params,
@@ -199,9 +228,12 @@ def block_apply(
     # per layer. Returning per-layer state as stacked scan outputs instead
     # would materialize a full copy of the whole cache every step, doubling
     # HBM traffic on the bandwidth-bound decode path.
+    whole_w, scanned_w = _split_int4_stacks(layer_params)
+
     def step(carry, xs):
         x, bufs = carry
         p, idx = xs
+        p = {**p, **_int4_views(whole_w, idx)}
         layer_state = tuple(
             jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
             for b in bufs
@@ -216,7 +248,7 @@ def block_apply(
         return (out, bufs), None
 
     (x, new_stacks), _ = jax.lax.scan(
-        step, (x, stacks), (layer_params, jnp.arange(num_stack))
+        step, (x, stacks), (scanned_w, jnp.arange(num_stack))
     )
     return x, cache.with_layer_stacks(*new_stacks)
 
@@ -338,7 +370,13 @@ def multi_decode_apply(
     # stacks pass through whole with the layer index appended; the kernel's
     # block index map resolves the layer, so the operand is zero-copy.
     whole_big = getattr(cache, "tail_reads_whole_big", False)
+    # Whole-tail mode (in-kernel tail): like the big stacks, the tail
+    # buffers pass through UNSLICED — the kernel aliases them in place and
+    # indexes the layer itself, so the scan neither slices nor re-inserts
+    # per-layer tail state.
+    whole_tail = getattr(cache, "tail_in_kernel", False)
     view_num_big = num_big + 1 if whole_big else num_big
+    whole_w, scanned_w = _split_int4_stacks(params["layers"])
 
     def token_step(carry, i):
         tokens, tail, tail_len, num_new, state = carry
@@ -352,27 +390,34 @@ def multi_decode_apply(
             x, tail_bufs = carry2
             p = xs[0]
             idx = xs[-1]
+            p = {**p, **_int4_views(whole_w, idx)}
             if whole_big:
                 big_state = (*big_stacks, idx)
             else:
                 big_state = tuple(xs[1 : 1 + num_big])
-            tail_state = tuple(
-                jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
-                for b in tail_bufs
-            )
+            if whole_tail:
+                tail_state = tail_bufs
+            else:
+                tail_state = tuple(
+                    jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
+                    for b in tail_bufs
+                )
             out, new_state = _decoder_layer(
                 cfg, p, x, (*big_state, *tail_state), view, rope, q_pos,
                 num_new,
             )
-            tail_bufs = tuple(
-                jax.lax.dynamic_update_index_in_dim(b, n, idx, 0)
-                for b, n in zip(tail_bufs, new_state[view_num_big:])
-            )
+            if whole_tail:
+                tail_bufs = tuple(new_state[view_num_big:])
+            else:
+                tail_bufs = tuple(
+                    jax.lax.dynamic_update_index_in_dim(b, n, idx, 0)
+                    for b, n in zip(tail_bufs, new_state[view_num_big:])
+                )
             return (out, tail_bufs), None
 
         (x, tail), _ = jax.lax.scan(
             layer_step, (x, tail),
-            (params["layers"],
+            (scanned_w,
              *(() if whole_big else big_stacks),
              jnp.arange(num_stack)),
         )

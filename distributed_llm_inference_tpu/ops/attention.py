@@ -262,65 +262,6 @@ def gqa_attention_quantized_segments(
     return (out / denom).reshape(b, s, hq, d).astype(q.dtype)
 
 
-def merge_softmax_segments_quantized(
-    q: jnp.ndarray,
-    out_a: jnp.ndarray,
-    m_a: jnp.ndarray,
-    l_a: jnp.ndarray,
-    tk: jnp.ndarray,
-    tks: jnp.ndarray,
-    tv: jnp.ndarray,
-    tvs: jnp.ndarray,
-    tail_valid: jnp.ndarray,
-    scale: Optional[float] = None,
-) -> jnp.ndarray:
-    """As :func:`merge_softmax_segments`, but the tail segment stays in its
-    int8 HEAD-major storage form (``tk``/``tv`` ``[B, Hkv, K, D]``,
-    ``tks``/``tvs`` ``[B, Hkv, K]``): scores are computed on the int8 values
-    with the scales applied to scores/probs, exactly like
-    :func:`gqa_attention_quantized_segments` — no dequantized tail copy, no
-    time-major transpose (the transpose+dequant formulation measured ~5x the
-    tail's cost in the fused decode loop).
-    """
-    b, s, hq, d = q.shape
-    hkv, kk = tk.shape[1], tk.shape[2]
-    g = hq // hkv
-    if scale is None:
-        scale = d**-0.5
-    qg = q.reshape(b, s, hkv, g, d)
-
-    sc = jnp.einsum(
-        "bskgd,bktd->bkgst", qg, tk.astype(q.dtype),
-        preferred_element_type=jnp.float32,
-    )
-    sc = sc * (tks[:, :, None, None, :] * scale)         # [B, Hkv, G, 1, K]
-    mask = tail_valid[:, None, None, None, :]
-    sc = jnp.where(mask, sc, _NEG_INF)
-    m_t = jnp.max(sc, axis=-1)                           # [B, Hkv, G, 1]
-    w = jnp.where(mask, jnp.exp(sc - m_t[..., None]), 0.0)
-    l_t = jnp.sum(w, axis=-1)                            # [B, Hkv, G, 1]
-    wv = (w * tvs[:, :, None, None, :]).astype(q.dtype)
-    pv_t = jnp.einsum(
-        "bkgst,bktd->bskgd", wv, tv.astype(q.dtype),
-        preferred_element_type=jnp.float32,
-    )                                                    # [B, 1, Hkv, G, D]
-    out_t = pv_t / jnp.maximum(l_t, 1e-20).reshape(b, 1, hkv, g, 1)
-
-    m_t = m_t[..., 0]
-    l_t = l_t[..., 0]
-    m = jnp.maximum(m_a, m_t)                            # [B, Hkv, G]
-    w_a = l_a * jnp.exp(m_a - m)
-    w_t = l_t * jnp.exp(m_t - m)
-    denom = jnp.maximum(w_a + w_t, 1e-20)
-    fa = (w_a / denom)[:, None, :, :, None]
-    ft = (w_t / denom)[:, None, :, :, None]
-    out = (
-        out_a.reshape(b, s, hkv, g, d).astype(jnp.float32) * fa
-        + out_t * ft
-    )
-    return out.reshape(b, s, hq, d).astype(q.dtype)
-
-
 def merge_softmax_segments(
     q: jnp.ndarray,
     out_a: jnp.ndarray,

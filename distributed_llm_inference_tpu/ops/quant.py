@@ -37,6 +37,7 @@ __all__ = [
     "QuantizedTensor",
     "QuantizedTensor4",
     "QuantizedTensor4Split",
+    "QuantizedTensor4SplitView",
     "quantize_int8",
     "quantize_int4",
     "quantize_int4_split",
@@ -153,6 +154,37 @@ class QuantizedTensor4Split(struct.PyTreeNode):
         ).reshape(*self.q.shape[:-2], -1)
 
 
+class QuantizedTensor4SplitView(struct.PyTreeNode):
+    """One layer's int4 weight, VIEWED out of the layer-stacked tensor with
+    a traced ``layer`` index instead of being sliced.
+
+    Why this exists: inside ``lax.scan`` over layers, slicing a
+    :class:`QuantizedTensor4Split` leaf out of the ``[L, ...]`` stack to
+    feed the Pallas matmul materializes a full HBM copy of that layer's
+    packed weight every (layer, step) — XLA cannot fuse a dynamic-slice
+    into a custom call operand. That copy traffic (read + write + re-read ≈
+    3x the weight bytes) is exactly why the int4 deployment measured SLOWER
+    than int8 despite reading half the bytes. The view keeps the whole
+    stack as the kernel operand and folds ``layer`` into the block index
+    map (same pattern as the whole-stack KV kernels, quant_attention.py).
+    """
+
+    q: jax.Array         # [L, in_pad, out_pad // 2] int8
+    scale_lo: jax.Array  # [L, 1, out_pad // 2] f32
+    scale_hi: jax.Array  # [L, 1, out_pad // 2] f32
+    layer: jax.Array     # scalar int32 (traced)
+    in_dim: int = struct.field(pytree_node=False, default=0)
+    out_dim: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def shape(self):
+        return (self.in_dim, self.out_dim)
+
+    @property
+    def dtype(self):
+        return self.scale_lo.dtype
+
+
 def quantize_int4_split(w: jax.Array) -> QuantizedTensor4Split:
     """Symmetric per-output-channel int4 in the half-split Pallas layout.
 
@@ -237,6 +269,29 @@ def matmul(x: jax.Array, w) -> jax.Array:
     if isinstance(w, QuantizedTensor):
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(x.dtype)
+    if isinstance(w, QuantizedTensor4SplitView):
+        import numpy as np
+
+        from .quant_matmul import int4_matmul_stacked, unpack_int4_split
+
+        rows = int(np.prod(x.shape[:-1]))
+        if rows <= 256:
+            return int4_matmul_stacked(
+                x, w.q, w.scale_lo, w.scale_hi, w.layer, w.out_dim
+            )
+        # Many-row (prefill) calls: slice the layer (amortized over rows)
+        # and run the plain XLA dequant matmul.
+        wq = jax.lax.dynamic_index_in_dim(w.q, w.layer, 0, keepdims=False)
+        slo = jax.lax.dynamic_index_in_dim(
+            w.scale_lo, w.layer, 0, keepdims=False
+        )
+        shi = jax.lax.dynamic_index_in_dim(
+            w.scale_hi, w.layer, 0, keepdims=False
+        )
+        w4 = unpack_int4_split(wq)[: x.shape[-1]]
+        y = x @ w4.astype(x.dtype)
+        sc = jnp.concatenate([slo, shi], axis=-1).reshape(-1)
+        return (y * sc.astype(x.dtype))[..., : w.out_dim]
     if isinstance(w, QuantizedTensor4Split):
         import numpy as np
 

@@ -47,20 +47,18 @@ def _qdense_kernel(
     ks_ref,     # [1, Hkv, BT] f32
     v_ref,      # [1, Hkv, BT, D] int8
     vs_ref,     # [1, Hkv, BT] f32
-    *refs,      # out_ref [, m_out_ref, l_out_ref], acc_ref, m_ref, l_ref
+    out_ref,    # [1, Hkv, G, D]
+    acc_ref,    # VMEM [Hkv*G, D] f32
+    m_ref,      # VMEM [Hkv*G, 128] f32
+    l_ref,      # VMEM [Hkv*G, 128] f32
+    *,
     scale: float,
     block_t: int,
     num_blocks: int,
     sliding_window: Optional[int],
     hkv: int,
     g: int,
-    with_stats: bool,
 ):
-    if with_stats:
-        out_ref, m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = refs
-    else:
-        out_ref, acc_ref, m_ref, l_ref = refs
-        m_out_ref = l_out_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -127,9 +125,6 @@ def _qdense_kernel(
         l = l_ref[:, :1]
         out = acc_ref[:] / jnp.maximum(l, 1e-20)
         out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
-        if with_stats:
-            m_out_ref[0] = m_ref[:]
-            l_out_ref[0] = l_ref[:]
 
 
 def quantized_decode_attention(
@@ -144,8 +139,7 @@ def quantized_decode_attention(
     block_t: int = 128,
     interpret: Optional[bool] = None,
     q_positions: Optional[jnp.ndarray] = None,
-    return_stats: bool = False,
-):
+) -> jnp.ndarray:
     """Decode attention straight over the int8 head-major dense cache.
 
     ``q``: ``[B, 1, Hq, D]`` (already rotated); ``k_q``/``v_q``: int8
@@ -155,12 +149,7 @@ def quantized_decode_attention(
     ``[B, 1, Hq, D]`` in q's dtype.
 
     ``q_positions`` (``[B]``, default ``kv_lengths - 1``): the absolute
-    position of each row's query, which anchors the sliding window — the
-    fused-decode caller passes ``base_len + tail_len`` so the window stays
-    correct while the big segment is frozen at ``base_len``.
-    ``return_stats=True`` additionally returns the online-softmax stats
-    ``(m, l)`` as ``[B, Hkv, G]`` f32 for a joint merge with another segment
-    (``ops.attention.merge_softmax_segments``).
+    position of each row's query, which anchors the sliding window.
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -193,28 +182,6 @@ def quantized_decode_attention(
         live = ji * bt < lens[bi]
         return (bi, 0, jnp.where(live, ji, 0))
 
-    out_specs = [
-        pl.BlockSpec(
-            (1, hkv, g, d), lambda bi, ji, lens, qpos: (bi, 0, 0, 0)
-        ),
-    ]
-    out_shapes = [jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype)]
-    if return_stats:
-        # m/l outputs exist only when a caller merges with another segment;
-        # the plain decode path skips them (2*B*Hkv*G*128*4 bytes of HBM
-        # writes per (layer, step) it would otherwise discard).
-        out_specs += [
-            pl.BlockSpec(
-                (1, hkv * g, 128), lambda bi, ji, lens, qpos: (bi, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, hkv * g, 128), lambda bi, ji, lens, qpos: (bi, 0, 0)
-            ),
-        ]
-        out_shapes += [
-            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
-            jax.ShapeDtypeStruct((b, hkv * g, 128), jnp.float32),
-        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, num_blocks),
@@ -227,7 +194,9 @@ def quantized_decode_attention(
             pl.BlockSpec((1, hkv, bt, d), _tile_index),
             pl.BlockSpec((1, hkv, bt), _tile_index3),
         ],
-        out_specs=tuple(out_specs),
+        out_specs=pl.BlockSpec(
+            (1, hkv, g, d), lambda bi, ji, lens, qpos: (bi, 0, 0, 0)
+        ),
         scratch_shapes=[
             pltpu.VMEM((hkv * g, d), jnp.float32),
             pltpu.VMEM((hkv * g, 128), jnp.float32),
@@ -242,128 +211,137 @@ def quantized_decode_attention(
         sliding_window=sliding_window,
         hkv=hkv,
         g=g,
-        with_stats=return_stats,
     )
-    res = pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        out_shape=tuple(out_shapes),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )(kv_lengths.astype(jnp.int32), q_positions.astype(jnp.int32),
       qr, k_q, ks, v_q, vs)
-    if return_stats:
-        out, m, l = res
-        out = out.reshape(b, 1, hq, d)
-        return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)
-    return res[0].reshape(b, 1, hq, d)
+    return out.reshape(b, 1, hq, d)
 
 
-def quantized_decode_attention_stacked(
+def quantized_fused_decode_attention(
     q: jnp.ndarray,
-    k_q: jnp.ndarray,
-    ks: jnp.ndarray,
-    v_q: jnp.ndarray,
-    vs: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    big_k: jnp.ndarray,
+    big_ks: jnp.ndarray,
+    big_v: jnp.ndarray,
+    big_vs: jnp.ndarray,
+    tail_k: jnp.ndarray,
+    tail_ks: jnp.ndarray,
+    tail_v: jnp.ndarray,
+    tail_vs: jnp.ndarray,
     layer_idx: jnp.ndarray,
-    kv_lengths: jnp.ndarray,
+    step_idx: jnp.ndarray,
+    base_len: jnp.ndarray,
+    tail_valid_len: jnp.ndarray,
+    q_positions: jnp.ndarray,
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
     block_t: int = 128,
     block_b: int = 8,
     interpret: Optional[bool] = None,
-    q_positions: Optional[jnp.ndarray] = None,
 ):
-    """As :func:`quantized_decode_attention` + stats, but over the WHOLE
-    layer-stacked cache ``[L, B, Hkv, T, D]`` with a traced ``layer_idx``.
+    """ONE kernel for a whole fused-decode attention step: quantizes the
+    step's fresh K/V, writes them into the write-behind tail IN PLACE
+    (io-aliased whole-stack tail operands), and runs the joint softmax over
+    the read-only big segment plus the updated tail — the tail is simply the
+    final online-softmax tile.
 
-    Two deliberate structural choices, both measured on v5e at batch 112
-    (Llama-7B shapes, fused 16-step decode):
+    Why: with the tail handled in XLA around a big-segment-only kernel, the
+    quantize + four dynamic-update-slices + tail einsums + stats merge cost
+    ~8 ms/step at batch 112 (Llama-7B shapes) — more than the big segment's
+    entire byte cost — because the custom call's layout constraints de-fuse
+    and re-layout every tail op. In-kernel, the tail round-trips VMEM once
+    per (layer, step) (~0.5 MB/row-block) and XLA never touches the int8
+    planes at all.
 
-    * Zero-copy operands. Inside the fused decode's layer scan, slicing one
-      layer's K/V out of the stack to feed a ``pallas_call`` materializes a
-      full HBM copy of that layer's buffers every (layer, step) — XLA cannot
-      fuse a dynamic-slice into a custom call's operand (tripled decode
-      cost). The stack passes through whole; the block index map resolves
-      the traced ``layer_idx``.
-    * Row-blocked grid. One batch row per grid step (the natural port of the
-      per-row paged kernel) issues ~1 MB DMAs and its per-step overhead
-      dominates: measured 1.57 ms per (layer, step) vs the XLA segment
-      path's 0.42 ms. ``block_b`` rows per step turn that into ~8 MB DMAs
-      over an 8x smaller grid.
+    Shapes: ``q`` ``[B, 1, Hq, D]`` (rotated); ``k_new``/``v_new``
+    ``[B, 1, Hkv, D]`` (k rotated); big stacks ``[L, B, Hkv, T, D]`` (+
+    ``[L, B, Hkv, T]`` scales); tail stacks ``[L, B, Hkv, KT, D]`` (+
+    scales). Scalars: ``layer_idx``/``step_idx`` traced ints; ``base_len``
+    ``[B]`` live big-segment length; ``tail_valid_len`` ``[B]`` =
+    ``tail_len + num_new`` (valid tail slots AFTER this write — a finished
+    row keeps its shorter span, so its slot-``step_idx`` garbage write is
+    never read); ``q_positions`` ``[B]`` = ``base_len + tail_len`` anchors
+    the sliding window.
 
-    Always returns ``(out, m, l)`` (stats for the tail merge);
-    ``kv_lengths`` is per-row live length of the big segment, and
-    ``q_positions`` anchors the sliding window.
+    Returns ``(out [B, 1, Hq, D], tail_k', tail_ks', tail_v', tail_vs')``
+    with the tail outputs aliased to the inputs (callers must treat the
+    inputs as consumed).
     """
     b, s, hq, d = q.shape
     if s != 1:
         raise ValueError(f"decode-only kernel (S=1), got S={s}")
-    num_l, _, hkv, t, _ = k_q.shape
+    num_l, _, hkv, t, _ = big_k.shape
+    kt = tail_k.shape[3]
     g = hq // hkv
     if scale is None:
         scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if q_positions is None:
-        q_positions = kv_lengths - 1
     bt = min(block_t, t)
     num_blocks = -(-t // bt)
-    nb = min(block_b, b)
-    num_row_blocks = -(-b // nb)
-    bp = num_row_blocks * nb
-    if bp != b:
-        # Pad the small per-row operands only (q/lengths); the KV stack is
-        # never padded — padding it would copy the multi-GB buffer inside
-        # the decode loop. Pad rows read KV tile 0 (masked: length 0).
-        q = jnp.pad(q, ((0, bp - b), (0, 0), (0, 0), (0, 0)))
-        kv_lengths = jnp.pad(kv_lengths, (0, bp - b))
-        q_positions = jnp.pad(q_positions, (0, bp - b))
+    # The io-aliased tail stacks cannot be batch-padded, so the row block
+    # must DIVIDE the batch: largest divisor <= block_b (worst case 1).
+    nb = next(n for n in range(min(block_b, b), 0, -1) if b % n == 0)
+    num_row_blocks = b // nb
 
-    qr = q.reshape(bp, hkv, g, d)
+    qr = q.reshape(b, hkv, g, d)
+    knr = jnp.moveaxis(k_new, 1, 2)  # [B, Hkv, 1, D]
+    vnr = jnp.moveaxis(v_new, 1, 2)
     lref = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    sref = jnp.asarray(step_idx, jnp.int32).reshape(1)
 
     def _row_live(bi, ji, lens):
-        # A KV time-tile is fetched iff ANY row in this row-block still has
-        # live tokens there; otherwise clamp to tile 0 (the pipeline elides
-        # the repeat fetch). Padded rows have length 0, never forcing tiles.
-        # ``lens`` is an SMEM ref: scalar reads only, unrolled over the block.
         live = ji * bt < lens[bi * nb]
         for r in range(1, nb):
             live |= ji * bt < lens[bi * nb + r]
         return live
 
-    def _tile_index(bi, ji, lidx, lens, qpos):
-        return (lidx[0], bi, 0, jnp.where(_row_live(bi, ji, lens), ji, 0), 0)
+    def _big_index(bi, ji, lidx, step, lens, vlen, qpos):
+        jc = jnp.minimum(ji, num_blocks - 1)  # tail step refetches nothing
+        return (lidx[0], bi, 0,
+                jnp.where(_row_live(bi, jc, lens), jc, 0), 0)
 
-    def _tile_index3(bi, ji, lidx, lens, qpos):
-        return (lidx[0], bi, 0, jnp.where(_row_live(bi, ji, lens), ji, 0))
+    def _big_index3(bi, ji, lidx, step, lens, vlen, qpos):
+        jc = jnp.minimum(ji, num_blocks - 1)
+        return (lidx[0], bi, 0, jnp.where(_row_live(bi, jc, lens), jc, 0))
+
+    def _tail_index(bi, ji, lidx, step, lens, vlen, qpos):
+        return (lidx[0], bi, 0, 0, 0)
+
+    def _tail_index3(bi, ji, lidx, step, lens, vlen, qpos):
+        return (lidx[0], bi, 0, 0)
+
+    def _row_index(bi, ji, lidx, step, lens, vlen, qpos):
+        return (bi, 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(num_row_blocks, num_blocks),
+        num_scalar_prefetch=5,
+        grid=(num_row_blocks, num_blocks + 1),
         in_specs=[
-            pl.BlockSpec(
-                (nb, hkv, g, d),
-                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0, 0),
-            ),
-            pl.BlockSpec((1, nb, hkv, bt, d), _tile_index),
-            pl.BlockSpec((1, nb, hkv, bt), _tile_index3),
-            pl.BlockSpec((1, nb, hkv, bt, d), _tile_index),
-            pl.BlockSpec((1, nb, hkv, bt), _tile_index3),
+            pl.BlockSpec((nb, hkv, g, d), _row_index),
+            pl.BlockSpec((nb, hkv, 1, d), _row_index),
+            pl.BlockSpec((nb, hkv, 1, d), _row_index),
+            pl.BlockSpec((1, nb, hkv, bt, d), _big_index),
+            pl.BlockSpec((1, nb, hkv, bt), _big_index3),
+            pl.BlockSpec((1, nb, hkv, bt, d), _big_index),
+            pl.BlockSpec((1, nb, hkv, bt), _big_index3),
+            pl.BlockSpec((1, nb, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, nb, hkv, kt), _tail_index3),
+            pl.BlockSpec((1, nb, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, nb, hkv, kt), _tail_index3),
         ],
         out_specs=(
-            pl.BlockSpec(
-                (nb, hkv, g, d),
-                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (nb, hkv * g, 128),
-                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0),
-            ),
-            pl.BlockSpec(
-                (nb, hkv * g, 128),
-                lambda bi, ji, lidx, lens, qpos: (bi, 0, 0),
-            ),
+            pl.BlockSpec((nb, hkv, g, d), _row_index),
+            pl.BlockSpec((1, nb, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, nb, hkv, kt), _tail_index3),
+            pl.BlockSpec((1, nb, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, nb, hkv, kt), _tail_index3),
         ),
         scratch_shapes=[
             pltpu.VMEM((nb, hkv * g, d), jnp.float32),
@@ -372,7 +350,7 @@ def quantized_decode_attention_stacked(
         ],
     )
     kernel = functools.partial(
-        _qdense_stacked_kernel,
+        _qfused_kernel,
         scale=scale,
         block_t=bt,
         num_blocks=num_blocks,
@@ -380,45 +358,55 @@ def quantized_decode_attention_stacked(
         hkv=hkv,
         g=g,
         nb=nb,
+        kt=kt,
     )
-    out, m, l = pl.pallas_call(
+    out, tk, tks, tv, tvs = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((bp, hkv, g, d), q.dtype),
-            jax.ShapeDtypeStruct((bp, hkv * g, 128), jnp.float32),
-            jax.ShapeDtypeStruct((bp, hkv * g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct(tail_k.shape, tail_k.dtype),
+            jax.ShapeDtypeStruct(tail_ks.shape, tail_ks.dtype),
+            jax.ShapeDtypeStruct(tail_v.shape, tail_v.dtype),
+            jax.ShapeDtypeStruct(tail_vs.shape, tail_vs.dtype),
         ),
         grid_spec=grid_spec,
         interpret=interpret,
+        # Tail stacks update in place; indices count every flattened input
+        # including the 5 scalar-prefetch operands.
+        input_output_aliases={12: 1, 13: 2, 14: 3, 15: 4},
         compiler_params=pltpu.CompilerParams(
-            # Row blocks are independent; time-tiles carry the softmax
-            # scratch. The default 16 MB scoped-vmem budget rejects the
-            # double-buffered 4 MB K/V tiles, so raise it (v5e has 128 MB).
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
-    )(lref, kv_lengths.astype(jnp.int32), q_positions.astype(jnp.int32),
-      qr, k_q, ks, v_q, vs)
-    out = out[:b].reshape(b, 1, hq, d)
-    return (
-        out,
-        m[:b, :, 0].reshape(b, hkv, g),
-        l[:b, :, 0].reshape(b, hkv, g),
-    )
+    )(lref, sref, base_len.astype(jnp.int32),
+      tail_valid_len.astype(jnp.int32), q_positions.astype(jnp.int32),
+      qr, knr, vnr, big_k, big_ks, big_v, big_vs,
+      tail_k, tail_ks, tail_v, tail_vs)
+    return out.reshape(b, 1, hq, d), tk, tks, tv, tvs
 
 
-def _qdense_stacked_kernel(
-    lidx_ref,   # SMEM [1] int32 (layer index; consumed by the index maps)
-    len_ref,    # SMEM [B] int32
-    qpos_ref,   # SMEM [B] int32
+def _qfused_kernel(
+    lidx_ref,   # SMEM [1] int32 (layer; consumed by index maps)
+    step_ref,   # SMEM [1] int32 (tail write slot)
+    len_ref,    # SMEM [B] int32 (big live length)
+    vlen_ref,   # SMEM [B] int32 (valid tail slots incl. this write)
+    qpos_ref,   # SMEM [B] int32 (query positions)
     q_ref,      # [NB, Hkv, G, D]
+    kn_ref,     # [NB, Hkv, 1, D] (rotated, unquantized)
+    vn_ref,     # [NB, Hkv, 1, D]
     k_ref,      # [1, NB, Hkv, BT, D] int8
     ks_ref,     # [1, NB, Hkv, BT] f32
     v_ref,      # [1, NB, Hkv, BT, D] int8
     vs_ref,     # [1, NB, Hkv, BT] f32
+    tk_ref,     # [1, NB, Hkv, KT, D] int8 (in)
+    tks_ref,    # [1, NB, Hkv, KT] f32 (in)
+    tv_ref,     # [1, NB, Hkv, KT, D] int8 (in)
+    tvs_ref,    # [1, NB, Hkv, KT] f32 (in)
     out_ref,    # [NB, Hkv, G, D]
-    m_out_ref,  # [NB, Hkv*G, 128] f32
-    l_out_ref,  # [NB, Hkv*G, 128] f32
+    tk_out,     # aliased tail outputs
+    tks_out,
+    tv_out,
+    tvs_out,
     acc_ref,    # VMEM [NB, Hkv*G, D] f32
     m_ref,      # VMEM [NB, Hkv*G, 128] f32
     l_ref,      # VMEM [NB, Hkv*G, 128] f32
@@ -430,10 +418,8 @@ def _qdense_stacked_kernel(
     hkv: int,
     g: int,
     nb: int,
+    kt: int,
 ):
-    """Row-blocked variant of :func:`_qdense_kernel`: NB batch rows per grid
-    step share one (much larger) KV DMA; online-softmax state carries a
-    leading row axis."""
     bi = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -443,60 +429,116 @@ def _qdense_stacked_kernel(
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Per-row masks from SMEM scalars, unrolled over the row block (vector
-    # builds like ``.at[r].set`` lower to scatter, which Mosaic lacks).
-    pos = j * block_t + jax.lax.broadcasted_iota(
-        jnp.int32, (1, block_t), 1
-    )
-    row_valids = []
-    for r in range(nb):
-        vr = pos < len_ref[bi * nb + r]
-        if sliding_window is not None:
-            vr &= pos > qpos_ref[bi * nb + r] - sliding_window
-        row_valids.append(vr)
-    valid = jnp.stack(row_valids)              # [NB, 1, BT]
-
     q = q_ref[:]                               # [NB, Hkv, G, D]
-    k = k_ref[0]                               # [NB, Hkv, BT, D] int8
-    ks = ks_ref[0]                             # [NB, Hkv, BT] f32
 
-    s = jax.lax.dot_general(
-        q.astype(jnp.bfloat16).reshape(nb * hkv, g, -1),
-        k.astype(jnp.bfloat16).reshape(nb * hkv, block_t, -1),
-        (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    ).reshape(nb, hkv, g, block_t)     # bf16 MXU (Mosaic: one batch dim max)
-    s = s * ks[:, :, None, :]
-    s = (s * scale).reshape(nb, hkv * g, block_t)
-    s = jnp.where(valid, s, _NEG_INF)          # valid [NB, 1, BT] broadcasts
+    def _accumulate(s, valid):
+        """One online-softmax tile: scores ``s`` [NB, Hkv*G, W] masked by
+        ``valid`` [NB, 1, W]; returns probs for the PV accumulation."""
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        return p, alpha
 
-    m_prev = m_ref[:, :, :1]                   # [NB, Hkv*G, 1]
-    l_prev = l_ref[:, :, :1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    @pl.when(j < num_blocks)
+    def _big_tile():
+        pos = j * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_t), 1
+        )
+        row_valids = []
+        for r in range(nb):
+            vr = pos < len_ref[bi * nb + r]
+            if sliding_window is not None:
+                vr &= pos > qpos_ref[bi * nb + r] - sliding_window
+            row_valids.append(vr)
+        valid = jnp.stack(row_valids)          # [NB, 1, BT]
 
-    l_ref[:] = jnp.broadcast_to(
-        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
-    )
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        k = k_ref[0]                           # [NB, Hkv, BT, D] int8
+        ks = ks_ref[0]
+        s = jax.lax.dot_general(
+            q.astype(jnp.bfloat16).reshape(nb * hkv, g, -1),
+            k.astype(jnp.bfloat16).reshape(nb * hkv, block_t, -1),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(nb, hkv, g, block_t)
+        s = (s * ks[:, :, None, :] * scale).reshape(nb, hkv * g, block_t)
+        p, alpha = _accumulate(s, valid)
 
-    v = v_ref[0]                               # [NB, Hkv, BT, D] int8
-    vs = vs_ref[0]                             # [NB, Hkv, BT] f32
-    pw = p.reshape(nb, hkv, g, block_t) * vs[:, :, None, :]
-    pv = jax.lax.dot_general(
-        pw.astype(jnp.bfloat16).reshape(nb * hkv, g, block_t),
-        v.astype(jnp.bfloat16).reshape(nb * hkv, block_t, -1),
-        (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )                                          # [NB*Hkv, G, D]
-    acc_ref[:] = acc_ref[:] * alpha + pv.reshape(nb, hkv * g, -1)
+        v = v_ref[0]
+        vs = vs_ref[0]
+        pw = p.reshape(nb, hkv, g, block_t) * vs[:, :, None, :]
+        pv = jax.lax.dot_general(
+            pw.astype(jnp.bfloat16).reshape(nb * hkv, g, block_t),
+            v.astype(jnp.bfloat16).reshape(nb * hkv, block_t, -1),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(nb, hkv * g, -1)
 
-    @pl.when(j == num_blocks - 1)
-    def _finalize():
+    @pl.when(j == num_blocks)
+    def _tail_tile():
+        step = step_ref[0]
+        # Quantize this step's K/V (must match cache._quantize_kv: symmetric
+        # per-(token, head) absmax int8 with a 1e-8 floor and RNE rounding).
+        kn = kn_ref[:].astype(jnp.float32)     # [NB, Hkv, 1, D]
+        vn = vn_ref[:].astype(jnp.float32)
+        ksc = jnp.maximum(jnp.max(jnp.abs(kn), axis=-1), 1e-8) / 127.0
+        vsc = jnp.maximum(jnp.max(jnp.abs(vn), axis=-1), 1e-8) / 127.0
+        kq = jnp.clip(jnp.round(kn / ksc[..., None]), -127, 127).astype(
+            jnp.int8
+        )
+        vq = jnp.clip(jnp.round(vn / vsc[..., None]), -127, 127).astype(
+            jnp.int8
+        )
+
+        slot = jax.lax.broadcasted_iota(jnp.int32, (1, 1, kt, 1), 2)
+        hit4 = slot == step
+        hit3 = hit4[..., 0]
+        tk = jnp.where(hit4, kq, tk_ref[0])    # [NB, Hkv, KT, D]
+        tv = jnp.where(hit4, vq, tv_ref[0])
+        tks = jnp.where(hit3, ksc, tks_ref[0])  # [NB, Hkv, KT]
+        tvs = jnp.where(hit3, vsc, tvs_ref[0])
+        tk_out[0] = tk
+        tv_out[0] = tv
+        tks_out[0] = tks
+        tvs_out[0] = tvs
+
+        pos1 = jax.lax.broadcasted_iota(jnp.int32, (1, kt), 1)
+        row_valids = []
+        for r in range(nb):
+            row = bi * nb + r
+            vr = pos1 < vlen_ref[row]
+            if sliding_window is not None:
+                tail_pos = len_ref[row] + pos1
+                vr &= tail_pos > qpos_ref[row] - sliding_window
+            row_valids.append(vr)
+        valid = jnp.stack(row_valids)          # [NB, 1, KT]
+
+        s = jax.lax.dot_general(
+            q.astype(jnp.bfloat16).reshape(nb * hkv, g, -1),
+            tk.astype(jnp.bfloat16).reshape(nb * hkv, kt, -1),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(nb, hkv, g, kt)
+        s = (s * tks[:, :, None, :] * scale).reshape(nb, hkv * g, kt)
+        p, alpha = _accumulate(s, valid)
+
+        pw = p.reshape(nb, hkv, g, kt) * tvs[:, :, None, :]
+        pv = jax.lax.dot_general(
+            pw.astype(jnp.bfloat16).reshape(nb * hkv, g, kt),
+            tv.astype(jnp.bfloat16).reshape(nb * hkv, kt, -1),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(nb, hkv * g, -1)
+
         l = l_ref[:, :, :1]
         out = acc_ref[:] / jnp.maximum(l, 1e-20)
         out_ref[:] = out.reshape(nb, hkv, g, -1).astype(out_ref.dtype)
-        m_out_ref[:] = m_ref[:]
-        l_out_ref[:] = l_ref[:]

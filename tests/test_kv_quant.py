@@ -123,55 +123,96 @@ def test_quantized_cache_sharded_matches_single_device():
 
 
 @pytest.mark.parametrize("window", [None, 7, 3])
-def test_stacked_kernel_tail_merge_matches_segments(window):
-    """The fused-decode kernel path (whole-stack Pallas big segment +
-    quantized head-major tail merge) matches the XLA two-segment joint
-    softmax across sliding windows — locks in the ``q_positions`` window
+def test_fused_kernel_matches_two_segment_reference(window):
+    """``quantized_fused_decode_attention`` (the production fused-decode
+    path: in-kernel quantize, io-aliased tail write, big+tail joint
+    softmax) matches the XLA quantize + update-slice + two-segment
+    reference across sliding windows — locks in the ``q_positions`` window
     anchor (the big segment is frozen at ``base_len`` while the query sits
-    at ``base_len + tail_len``)."""
-    from distributed_llm_inference_tpu.cache.dense import segment_valids
+    at ``base_len + tail_len``) and the byte-exact tail write."""
+    from distributed_llm_inference_tpu.cache.dense import (
+        _quantize_kv,
+        segment_valids,
+    )
     from distributed_llm_inference_tpu.ops.attention import (
         gqa_attention_quantized_segments,
-        merge_softmax_segments_quantized,
     )
     from distributed_llm_inference_tpu.ops.quant_attention import (
-        quantized_decode_attention_stacked,
+        quantized_fused_decode_attention,
     )
 
     L, B, HKV, G, T, KT, D = 2, 3, 2, 2, 20, 4, 16
     rng = jax.random.PRNGKey(3)
-    ks = jax.random.split(rng, 8)
+    ks = jax.random.split(rng, 10)
     q = jax.random.normal(ks[0], (B, 1, HKV * G, D), jnp.float32)
+    k_new = jax.random.normal(ks[8], (B, 1, HKV, D), jnp.float32)
+    v_new = jax.random.normal(ks[9], (B, 1, HKV, D), jnp.float32)
     big_k = jax.random.randint(ks[1], (L, B, HKV, T, D), -127, 127, jnp.int8)
     big_v = jax.random.randint(ks[2], (L, B, HKV, T, D), -127, 127, jnp.int8)
     big_ks = jnp.abs(jax.random.normal(ks[3], (L, B, HKV, T))) * 0.02
     big_vs = jnp.abs(jax.random.normal(ks[4], (L, B, HKV, T))) * 0.02
-    tk = jax.random.randint(ks[5], (B, HKV, KT, D), -127, 127, jnp.int8)
-    tv = jax.random.randint(ks[6], (B, HKV, KT, D), -127, 127, jnp.int8)
-    tks = jnp.abs(jax.random.normal(ks[7], (B, HKV, KT))) * 0.02
+    tk = jax.random.randint(
+        ks[5], (L, B, HKV, KT, D), -127, 127, jnp.int8
+    )
+    tv = jax.random.randint(
+        ks[6], (L, B, HKV, KT, D), -127, 127, jnp.int8
+    )
+    tks = jnp.abs(jax.random.normal(ks[7], (L, B, HKV, KT))) * 0.02
     tvs = tks * 0.5 + 0.01
     base_len = jnp.asarray([13, 20, 5], jnp.int32)
-    tail_len = jnp.asarray([2, 1, 0], jnp.int32)
-    num_new = jnp.ones((B,), jnp.int32)
+    # Row 2 is FINISHED (num_new=0): its tail stays frozen at length 0 and
+    # the garbage write at step_idx must never become visible.
+    tail_len = jnp.asarray([2, 2, 0], jnp.int32)
+    num_new = jnp.asarray([1, 1, 0], jnp.int32)
+    step_idx = 2
 
-    big_valid, tail_valid = segment_valids(
-        base_len, tail_len, num_new, T, KT, window
-    )
     for layer in range(L):
+        # XLA reference: quantize, write slot step_idx, two-segment joint
+        # softmax over the masked big + tail.
+        k_q, k_s = _quantize_kv(k_new)
+        v_q, v_s = _quantize_kv(v_new)
+        rtk = jnp.asarray(tk[layer]).at[:, :, step_idx, :].set(
+            jnp.moveaxis(k_q, 1, 2)[:, :, 0, :]
+        )
+        rtv = jnp.asarray(tv[layer]).at[:, :, step_idx, :].set(
+            jnp.moveaxis(v_q, 1, 2)[:, :, 0, :]
+        )
+        rtks = jnp.asarray(tks[layer]).at[:, :, step_idx].set(
+            jnp.moveaxis(k_s, 1, 2)[:, :, 0]
+        )
+        rtvs = jnp.asarray(tvs[layer]).at[:, :, step_idx].set(
+            jnp.moveaxis(v_s, 1, 2)[:, :, 0]
+        )
+        big_valid, tail_valid = segment_valids(
+            base_len, tail_len, num_new, T, KT, window
+        )
         ref = gqa_attention_quantized_segments(
             q,
             [
                 (big_k[layer], big_ks[layer], big_v[layer], big_vs[layer],
                  big_valid),
-                (tk, tks, tv, tvs, tail_valid),
+                (rtk, rtks, rtv, rtvs, tail_valid),
             ],
         )
-        out_b, m_b, l_b = quantized_decode_attention_stacked(
-            q, big_k, big_ks, big_v, big_vs, jnp.int32(layer), base_len,
-            sliding_window=window, q_positions=base_len + tail_len,
+
+        out, ntk, ntks, ntv, ntvs = quantized_fused_decode_attention(
+            q, k_new, v_new,
+            big_k, big_ks, big_v, big_vs,
+            tk, tks, tv, tvs,
+            layer_idx=jnp.int32(layer), step_idx=jnp.int32(step_idx),
+            base_len=base_len, tail_valid_len=tail_len + num_new,
+            q_positions=base_len + tail_len,
+            sliding_window=window,
         )
-        out = merge_softmax_segments_quantized(
-            q, out_b, m_b, l_b, tk, tks, tv, tvs, tail_valid
+        # Tail write-back: layer `layer` updated byte-exactly, others kept.
+        np.testing.assert_array_equal(np.asarray(ntk[layer]), np.asarray(rtk))
+        np.testing.assert_array_equal(np.asarray(ntv[layer]), np.asarray(rtv))
+        np.testing.assert_allclose(
+            np.asarray(ntks[layer]), np.asarray(rtks), rtol=1e-6
+        )
+        other = 1 - layer
+        np.testing.assert_array_equal(
+            np.asarray(ntk[other]), np.asarray(tk[other])
         )
         # the kernel's dots run in bf16 (MXU-native); the XLA reference
         # contracts in f32
