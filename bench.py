@@ -144,7 +144,8 @@ def _zero_q4s_params(cfg: ModelConfig, dtype=jnp.bfloat16):
 
 
 def _try_decode_bench(
-    cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache, scan_k=16
+    cfg, params, batch, ctx, steps=32, cache_cls=DenseKVCache, scan_k=16,
+    use_kernel=False,
 ):
     """Decode throughput at ``batch``: tokens/sec on this one chip.
 
@@ -166,9 +167,10 @@ def _try_decode_bench(
     writes = max(max(1, steps // k) * k, k)
     buf = min(ctx, ctx // 2 + writes)
     on_tpu = jax.default_backend() == "tpu"
+    kw = {"use_kernel": True} if use_kernel else {}
     cache = cache_cls.create(
         cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim,
-        jnp.bfloat16 if on_tpu else jnp.float32,
+        jnp.bfloat16 if on_tpu else jnp.float32, **kw,
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     num_new = jnp.ones((batch,), jnp.int32)
@@ -278,7 +280,8 @@ def _ttft_bench(cfg, params, prompt_len=128, reps=5, cache_cls=DenseKVCache):
     return float(np.percentile(times, 50)), device_ms
 
 
-def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
+def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache,
+                   use_kernel=False):
     """Largest-batch decode throughput that fits; ``(tok_s, batch)``.
 
     Each batch tries the fused K-step path first, then per-token dispatch:
@@ -298,7 +301,7 @@ def _decode_ladder(cfg, params, ladder, cache_cls=DenseKVCache):
             try:
                 tok_s = _try_decode_bench(
                     cfg, params, batch, ctx, cache_cls=cache_cls,
-                    scan_k=scan_k,
+                    scan_k=scan_k, use_kernel=use_kernel,
                 )
             except Exception as e:
                 # repr, not the exception: a held traceback pins the failed
@@ -496,6 +499,11 @@ PHASES = {
     "int4_kvq": (_zero_q4s_params,
                  ((160, 256), (128, 256), (112, 256), (96, 256), (64, 256)),
                  QuantizedDenseKVCache),
+    # int8 + int8KV decode through the FUSED Pallas kernel (in-kernel tail,
+    # zero-copy whole-stack operands — ops/quant_attention.py).
+    "int8_kvq_pallas": (_zero_qparams,
+                        ((112, 256), (96, 256), (64, 256), (32, 256)),
+                        "dense_kernel"),
     # int8 weights + Pallas paged-attention kernel over the page pool.
     "paged_pallas": (_zero_qparams, ((48, 256), (32, 256), (16, 256)),
                      "paged"),
@@ -867,7 +875,12 @@ def run_phase(name: str) -> dict:
         if name not in _NO_TTFT:
             ttft, ttft_dev = _ttft_bench(cfg, params, cache_cls=_PagedTTFTCache)
     else:
-        tok_s, batch = _decode_ladder(cfg, params, ladder, cache_cls)
+        use_kernel = cache_cls == "dense_kernel"
+        if use_kernel:
+            cache_cls = QuantizedDenseKVCache
+        tok_s, batch = _decode_ladder(
+            cfg, params, ladder, cache_cls, use_kernel=use_kernel
+        )
         ttft = ttft_dev = None
         if name not in _NO_TTFT:
             ttft, ttft_dev = _ttft_bench(cfg, params, cache_cls=cache_cls)

@@ -557,6 +557,11 @@ class QuantizedPagedKVCache(PagedKVCache):
         b, s, hkv, d = k_rot.shape
         k_q, k_s = _quantize_kv(k_rot)
         v_q, v_s = _quantize_kv(v_new)
+        if s > 1:
+            return self._scatter_planes(
+                layer_k, layer_v, layer_ks, layer_vs, k_q, v_q, k_s, v_s,
+                q_pos, num_new,
+            )
         phys_page, offset_bs = self._slot_pages(q_pos, num_new)
         if s == 1:
             page = phys_page[:, 0]
@@ -580,6 +585,15 @@ class QuantizedPagedKVCache(PagedKVCache):
             return jax.lax.fori_loop(
                 0, b, body, (layer_k, layer_v, layer_ks, layer_vs)
             )
+        raise AssertionError("s > 1 handled by _scatter_planes above")
+
+    def _scatter_planes(self, layer_k, layer_v, layer_ks, layer_vs,
+                        k_q, v_q, k_s, v_s, q_pos, num_new):
+        """Scatter PRE-QUANTIZED ``[B, S, Hkv(, D)]`` values + scales into
+        the pool (the fused kernel quantizes in-kernel; its tail flushes
+        through here without a second quantization)."""
+        b, s, hkv, d = k_q.shape
+        phys_page, offset_bs = self._slot_pages(q_pos, num_new)
         flat_page = phys_page.reshape(-1)
         flat_off = offset_bs.reshape(-1)
         return (
@@ -681,10 +695,33 @@ class QuantizedPagedKVCache(PagedKVCache):
             gs(self.ks_pages), gs(self.vs_pages),
         )
 
+    @property
+    def tail_reads_whole_big(self) -> bool:
+        """Kernel mode: the GATHERED contiguous stacks pass to the fused
+        kernel whole (+ layer index) — slicing a layer out of them to feed
+        the custom call would copy it through HBM every (layer, step)."""
+        return self.use_kernel
+
+    @property
+    def tail_in_kernel(self) -> bool:
+        return self.use_kernel
+
     def tail_init(self, k_steps: int):
         l = self.k_pages.shape[0]
         b = self.page_table.shape[0]
         hkv, d = self.k_pages.shape[2], self.k_pages.shape[4]
+        if self.use_kernel:
+            # int8 + scale planes, quantized IN-KERNEL with the same
+            # symmetric absmax scheme ``_scatter_q`` uses — the flush
+            # scatters these planes into the pool directly, so pool
+            # contents are bit-identical to the per-step write path.
+            # Distinct buffers: the kernel aliases each operand.
+            return (
+                jnp.zeros((l, b, hkv, k_steps, d), jnp.int8),
+                jnp.zeros((l, b, hkv, k_steps, d), jnp.int8),
+                jnp.zeros((l, b, hkv, k_steps), jnp.float32),
+                jnp.zeros((l, b, hkv, k_steps), jnp.float32),
+            )
         # bf16 head-major tail (quantized into pages only at flush, exactly
         # like the per-step path quantizes on write — pool contents match).
         z = jnp.zeros((l, b, hkv, k_steps, d), jnp.bfloat16)
@@ -696,10 +733,27 @@ class QuantizedPagedKVCache(PagedKVCache):
         from ..ops.attention import gqa_attention_quantized_segments
         from .dense import segment_valids
 
-        gk, gv, gks, gvs = big_state   # [B, Hkv, Tmax, D] int8 / f32 scales
-        tk, tv = tail_state            # [B, Hkv, K, D] bf16
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        if self.use_kernel and q.shape[1] == 1:
+            from ..ops.quant_attention import (
+                quantized_fused_decode_attention,
+            )
+
+            gk, gv, gks, gvs, lidx = big_state  # whole [L, ...] + layer idx
+            tk, tv, tks, tvs = tail_state
+            out, ntk, ntks, ntv, ntvs = quantized_fused_decode_attention(
+                q_rot, k_rot, v_new,
+                gk, gks, gv, gvs,
+                tk, tks, tv, tvs,
+                layer_idx=lidx, step_idx=step_idx,
+                base_len=base_len, tail_valid_len=tail_len + num_new,
+                q_positions=base_len + tail_len,
+                scale=scale, sliding_window=sliding_window,
+            )
+            return out, (ntk, ntv, ntks, ntvs)
+        gk, gv, gks, gvs = big_state   # [B, Hkv, Tmax, D] int8 / f32 scales
+        tk, tv = tail_state            # [B, Hkv, K, D] bf16
         tk = jax.lax.dynamic_update_slice_in_dim(
             tk, jnp.moveaxis(k_rot, 1, 2).astype(tk.dtype), step_idx, axis=2
         )
@@ -719,19 +773,32 @@ class QuantizedPagedKVCache(PagedKVCache):
         return out, (tk, tv)
 
     def tail_flush(self, tail, tail_len):
-        wk, wv = tail  # [L, B, Hkv, K, D] bf16 (keys already rotated)
-        kk = wk.shape[3]
+        kk = tail[0].shape[3]
         q_pos = (
             self.lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
         )
         num_new = tail_len
-        new_k, new_v, new_ks, new_vs = jax.vmap(
-            lambda lk, lv, lks, lvs, tkl, tvl: self._scatter_q(
-                lk, lv, lks, lvs,
-                jnp.moveaxis(tkl, 1, 2), jnp.moveaxis(tvl, 1, 2),
-                q_pos, num_new,
-            )
-        )(self.k_pages, self.v_pages, self.ks_pages, self.vs_pages, wk, wv)
+        if len(tail) == 4:  # kernel mode: pre-quantized int8 + scales
+            wk, wv, wks, wvs = tail  # [L, B, Hkv, K, D] / [L, B, Hkv, K]
+            new_k, new_v, new_ks, new_vs = jax.vmap(
+                lambda lk, lv, lks, lvs, tkl, tvl, tksl, tvsl:
+                self._scatter_planes(
+                    lk, lv, lks, lvs,
+                    jnp.moveaxis(tkl, 1, 2), jnp.moveaxis(tvl, 1, 2),
+                    jnp.swapaxes(tksl, 1, 2), jnp.swapaxes(tvsl, 1, 2),
+                    q_pos, num_new,
+                )
+            )(self.k_pages, self.v_pages, self.ks_pages, self.vs_pages,
+              wk, wv, wks, wvs)
+        else:
+            wk, wv = tail  # [L, B, Hkv, K, D] bf16 (keys already rotated)
+            new_k, new_v, new_ks, new_vs = jax.vmap(
+                lambda lk, lv, lks, lvs, tkl, tvl: self._scatter_q(
+                    lk, lv, lks, lvs,
+                    jnp.moveaxis(tkl, 1, 2), jnp.moveaxis(tvl, 1, 2),
+                    q_pos, num_new,
+                )
+            )(self.k_pages, self.v_pages, self.ks_pages, self.vs_pages, wk, wv)
         return self.replace(
             k_pages=new_k, v_pages=new_v, ks_pages=new_ks, vs_pages=new_vs,
             lengths=self.lengths + tail_len,

@@ -275,7 +275,14 @@ def matmul(x: jax.Array, w) -> jax.Array:
         from .quant_matmul import int4_matmul_stacked, unpack_int4_split
 
         rows = int(np.prod(x.shape[:-1]))
-        if rows <= 256:
+        # Decode (S == 1) takes the stacked kernel at ANY batch — the
+        # row-count heuristic alone would route large-batch decode (e.g.
+        # b384 GQA serving) to the slice path and reintroduce the
+        # per-(layer, step) weight copy this view exists to remove. The
+        # row threshold only gates genuine many-row prefill, where the
+        # XLA unpack amortizes and MXU shapes are already efficient.
+        decode = x.ndim >= 3 and x.shape[-2] == 1
+        if decode or rows <= 256:
             return int4_matmul_stacked(
                 x, w.q, w.scale_lo, w.scale_hi, w.layer, w.out_dim
             )

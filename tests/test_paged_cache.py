@@ -150,3 +150,65 @@ def test_quantized_paged_engine_matches_exact():
                       ("gather", run("int8", 1, False))):
         agree = sum(a == b for a, b in zip(ref, out))
         assert agree >= len(ref) - 1, (name, ref, out)
+
+
+def test_paged_fused_kernel_tail_matches_xla_path():
+    """kernel-mode fused decode (in-kernel quantize + io-aliased int8 tail +
+    big gathered segment in one Pallas call) emits the same tokens as the
+    XLA two-segment path and leaves the pool within 1 int8 LSB (the XLA
+    path's bf16 tail rounds once more before its flush-quantize; the kernel
+    quantizes the full-precision values directly)."""
+    import numpy as np
+
+    from distributed_llm_inference_tpu.cache.paged import (
+        PageAllocator,
+        QuantizedPagedKVCache,
+    )
+    from distributed_llm_inference_tpu.models import llama
+
+    cfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=160,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    B, PS, SLOTS, K = 3, 8, 4, 4
+
+    def run(use_kernel):
+        cache = QuantizedPagedKVCache.create(
+            cfg.num_layers, B, B * SLOTS + 1, PS, SLOTS, cfg.num_kv_heads,
+            cfg.head_dim, jnp.float32, use_kernel=use_kernel,
+        )
+        alloc = PageAllocator(B * SLOTS + 1)
+        for r in range(B):
+            cache = cache.assign_pages(r, alloc.alloc(SLOTS))
+        lens = jnp.asarray([9, 14, 5], jnp.int32)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (B, 16), 0, cfg.vocab_size
+        )
+        logits, cache = llama.model_apply(cfg, params, toks, cache, lens)
+        active = jnp.ones((B,), bool)
+
+        def step_fn(i, lg, alive):
+            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+            return nxt, alive.astype(jnp.int32), alive, nxt
+
+        first = jnp.argmax(
+            logits[jnp.arange(B), lens - 1], -1
+        )[:, None].astype(jnp.int32)
+        emits, cache = llama.multi_decode_apply(
+            cfg, params, first, cache, K, step_fn, active,
+            active.astype(jnp.int32),
+        )
+        return np.asarray(emits), cache
+
+    e0, c0 = run(False)
+    e1, c1 = run(True)
+    np.testing.assert_array_equal(e0, e1)
+    np.testing.assert_array_equal(
+        np.asarray(c0.lengths), np.asarray(c1.lengths)
+    )
+    dk = np.abs(
+        np.asarray(c0.k_pages, np.int32) - np.asarray(c1.k_pages, np.int32)
+    )
+    dv = np.abs(
+        np.asarray(c0.v_pages, np.int32) - np.asarray(c1.v_pages, np.int32)
+    )
+    assert dk.max() <= 1 and dv.max() <= 1, (dk.max(), dv.max())

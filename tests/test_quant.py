@@ -385,3 +385,69 @@ def test_engine_int4_tp_mesh_uses_grouped_layout():
     assert isinstance(eng.params["layers"]["wq"], QuantizedTensor4)
     outs = eng.generate([[1, 2, 3]], SamplingOptions(max_new_tokens=4))
     assert len(outs[0]) == 4
+
+
+def test_int4_stacked_view_matches_per_layer_kernel():
+    """The stacked int4 dispatch (QuantizedTensor4SplitView →
+    int4_matmul_stacked) is numerically exact against the per-layer kernel
+    and the dequant oracle on BOTH branches (decode-shaped batch-1-seq and
+    many-row prefill) for every layer index — locks in the block index
+    maps' layer resolution and the lo/hi scale pairing."""
+    import numpy as np
+
+    from distributed_llm_inference_tpu.ops.quant import (
+        QuantizedTensor4Split,
+        QuantizedTensor4SplitView,
+        matmul,
+        quantize_int4_split,
+    )
+    from distributed_llm_inference_tpu.ops.quant_matmul import (
+        unpack_int4_split,
+    )
+
+    L, IN, OUT = 3, 64, 96
+    w = (
+        jax.random.normal(jax.random.PRNGKey(2), (L, IN, OUT), jnp.float32)
+        * 0.05
+    )
+    q = quantize_int4_split(w)
+
+    def oracle(x2, layer):
+        w4 = np.asarray(unpack_int4_split(q.q[layer]))[:IN].astype(np.float32)
+        sc = np.concatenate(
+            [np.asarray(q.scale_lo[layer]), np.asarray(q.scale_hi[layer])],
+            -1,
+        ).reshape(-1)
+        return (np.asarray(x2, np.float32) @ w4) * sc
+
+    for layer in range(L):
+        view = QuantizedTensor4SplitView(
+            q.q, q.scale_lo, q.scale_hi, jnp.int32(layer), q.in_dim, q.out_dim
+        )
+        per_layer = QuantizedTensor4Split(
+            q.q[layer], q.scale_lo[layer], q.scale_hi[layer],
+            q.in_dim, q.out_dim,
+        )
+        # Decode shape [B, 1, IN] with B past the prefill row threshold:
+        # must STILL take the stacked kernel (slice path would re-copy).
+        xd = jax.random.normal(
+            jax.random.PRNGKey(layer), (300, 1, IN), jnp.float32
+        )
+        out_v = matmul(xd, view)
+        ref = oracle(xd.reshape(300, IN), layer)[:, :OUT].reshape(300, 1, OUT)
+        np.testing.assert_allclose(
+            np.asarray(out_v), ref, rtol=2e-2, atol=8e-3
+        )
+        out_p = matmul(xd[:200].reshape(200, IN), per_layer)
+        np.testing.assert_allclose(
+            np.asarray(out_v[:200, 0]), np.asarray(out_p),
+            rtol=1e-5, atol=1e-5,
+        )
+        # Many-row prefill [400, IN]: the XLA unpack branch of the view.
+        xp = jax.random.normal(
+            jax.random.PRNGKey(10 + layer), (400, IN), jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(matmul(xp, view)), oracle(xp, layer)[:, :OUT],
+            rtol=2e-2, atol=8e-3,
+        )
