@@ -697,6 +697,20 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         """Per-row K-token window merge (head-major: time axis 2 of the
         ``[L, Hkv, T(, D)]`` row view)."""
         wk, wv, wks, wvs = tail  # [L, B, Hkv, K, D] / [L, B, Hkv, K]
+        if self.use_kernel and self.max_len % 32 == 0:
+            # Blocked RMW merge: the XLA where/take rewrite of the whole
+            # big buffers costs ~58 ms per fused call at batch 112. (Tiny
+            # non-32-multiple buffers keep the XLA path.)
+            from ..ops.quant_attention import fused_tail_flush
+
+            nk, nks, nv, nvs = fused_tail_flush(
+                self.k, self.ks, self.v, self.vs, wk, wks, wv, wvs,
+                self.lengths, tail_len,
+            )
+            return self.replace(
+                k=nk, v=nv, ks=nks, vs=nvs,
+                lengths=self.lengths + tail_len,
+            )
         merge = lambda big, tl: _tail_flush_rows(
             big, tl, self.lengths, tail_len, axis=2
         )

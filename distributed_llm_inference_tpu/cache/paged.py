@@ -562,30 +562,29 @@ class QuantizedPagedKVCache(PagedKVCache):
                 layer_k, layer_v, layer_ks, layer_vs, k_q, v_q, k_s, v_s,
                 q_pos, num_new,
             )
+        # s == 1 from here (the s > 1 path returned above).
         phys_page, offset_bs = self._slot_pages(q_pos, num_new)
-        if s == 1:
-            page = phys_page[:, 0]
-            offset = offset_bs[:, 0]
+        page = phys_page[:, 0]
+        offset = offset_bs[:, 0]
 
-            def body(r, bufs):
-                bk, bv, bks, bvs = bufs
-                kv = k_q[r, 0][:, None, :]
-                vv = v_q[r, 0][:, None, :]
-                ks1 = k_s[r, 0][:, None]
-                vs1 = v_s[r, 0][:, None]
-                start = (page[r], 0, offset[r], 0)
-                start3 = (page[r], 0, offset[r])
-                return (
-                    jax.lax.dynamic_update_slice(bk, kv[None], start),
-                    jax.lax.dynamic_update_slice(bv, vv[None], start),
-                    jax.lax.dynamic_update_slice(bks, ks1[None], start3),
-                    jax.lax.dynamic_update_slice(bvs, vs1[None], start3),
-                )
-
-            return jax.lax.fori_loop(
-                0, b, body, (layer_k, layer_v, layer_ks, layer_vs)
+        def body(r, bufs):
+            bk, bv, bks, bvs = bufs
+            kv = k_q[r, 0][:, None, :]
+            vv = v_q[r, 0][:, None, :]
+            ks1 = k_s[r, 0][:, None]
+            vs1 = v_s[r, 0][:, None]
+            start = (page[r], 0, offset[r], 0)
+            start3 = (page[r], 0, offset[r])
+            return (
+                jax.lax.dynamic_update_slice(bk, kv[None], start),
+                jax.lax.dynamic_update_slice(bv, vv[None], start),
+                jax.lax.dynamic_update_slice(bks, ks1[None], start3),
+                jax.lax.dynamic_update_slice(bvs, vs1[None], start3),
             )
-        raise AssertionError("s > 1 handled by _scatter_planes above")
+
+        return jax.lax.fori_loop(
+            0, b, body, (layer_k, layer_v, layer_ks, layer_vs)
+        )
 
     def _scatter_planes(self, layer_k, layer_v, layer_ks, layer_vs,
                         k_q, v_q, k_s, v_s, q_pos, num_new):

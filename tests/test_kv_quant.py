@@ -243,3 +243,37 @@ def test_quantized_pallas_kernel_engine_parity():
     ref, out = run(False), run(True)
     agree = sum(a == b for a, b in zip(ref, out))
     assert agree >= len(ref) - 1, (ref, out)
+
+
+@pytest.mark.parametrize("kt", [16, 48])
+def test_fused_tail_flush_matches_xla_merge(kt):
+    """The blocked RMW flush kernel places exactly tail_len tokens per row
+    at each row's offset — parity with the XLA where/take merge across
+    in-block, block-spanning, empty, edge-partial, and buffer-end windows,
+    at KT=16 (the default tick) and KT=48 (windows spanning 3 value
+    blocks — the grid must scale with ceil(KT/32)+1)."""
+    from distributed_llm_inference_tpu.cache.dense import _tail_flush_rows
+    from distributed_llm_inference_tpu.ops.quant_attention import (
+        fused_tail_flush,
+    )
+
+    L, B, H, T, D = 2, 5, 3, 160, 8
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(rng.integers(-100, 100, s), jnp.int8)
+    bigk, bigv = mk(L, B, H, T, D), mk(L, B, H, T, D)
+    bigks = jnp.asarray(rng.random((L, B, H, T)), jnp.float32)
+    bigvs = jnp.asarray(rng.random((L, B, H, T)), jnp.float32)
+    tk, tv = mk(L, B, H, kt, D), mk(L, B, H, kt, D)
+    tks = jnp.asarray(rng.random((L, B, H, kt)), jnp.float32)
+    tvs = jnp.asarray(rng.random((L, B, H, kt)), jnp.float32)
+    base = jnp.asarray([10, 30, 70, T - 10, T - kt], jnp.int32)
+    tl = jnp.asarray([kt, kt, 0, 10, kt], jnp.int32)
+
+    nk, nks, nv, nvs = fused_tail_flush(
+        bigk, bigks, bigv, bigvs, tk, tks, tv, tvs, base, tl
+    )
+    for out, big, tail in (
+        (nk, bigk, tk), (nv, bigv, tv), (nks, bigks, tks), (nvs, bigvs, tvs),
+    ):
+        ref = _tail_flush_rows(big, tail, base, tl, axis=2)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
