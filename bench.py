@@ -494,11 +494,12 @@ PHASES = {
     "int8_kvq": (_zero_qparams,
                  ((112, 256), (96, 256), (64, 256), (32, 256), (1, 256)),
                  QuantizedDenseKVCache),
-    # int4 weights (half-split Pallas matmul) + int8 KV: weight bytes halve
-    # again vs int8, freeing HBM for larger batches on the same chip.
+    # int4 weights (half-split STACKED Pallas matmul) + int8 KV through the
+    # fused attention kernel: weight bytes halve vs int8, freeing HBM for
+    # larger batches on the same chip.
     "int4_kvq": (_zero_q4s_params,
                  ((160, 256), (128, 256), (112, 256), (96, 256), (64, 256)),
-                 QuantizedDenseKVCache),
+                 "dense_kernel"),
     # int8 + int8KV decode through the FUSED Pallas kernel (in-kernel tail,
     # zero-copy whole-stack operands — ops/quant_attention.py).
     "int8_kvq_pallas": (_zero_qparams,
@@ -522,14 +523,14 @@ PHASES = {
     # fit and the decode attention rides the MXU.
     "llama3_8b_int8_kvq": (_zero_qparams,
                            ((384, 256), (256, 256), (128, 256), (64, 256)),
-                           QuantizedDenseKVCache),
+                           "dense_kernel"),
     # Long-context decode (VERDICT r2 order 4): the ladder entries' ctx
     # makes ~half of it LIVE context, so these report tok/s where KV traffic
     # dominates (headline phases run ~128-160 live).
     "int8_kvq_1k": (_zero_qparams, ((24, 2048), (16, 2048), (8, 2048)),
-                    QuantizedDenseKVCache),
+                    "dense_kernel"),
     "int8_kvq_2k": (_zero_qparams, ((12, 4096), (8, 4096), (4, 4096)),
-                    QuantizedDenseKVCache),
+                    "dense_kernel"),
     "paged_kvq_1k": (_zero_qparams, ((16, 2048), (12, 2048), (8, 2048)),
                      "paged_kvq"),
     # StreamingLLM sink ring mid-stream (signature feature) — _sink_phase().
