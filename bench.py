@@ -756,9 +756,12 @@ def _mistral_phase() -> dict:
     err = None
     for batch in ((32, 16) if on_tpu else (4,)):
         try:
+            # ticks=10: the 4-tick window (~1 s) made this phase hostage to
+            # single tunnel-latency hiccups (measured 1115-2547 tok/s across
+            # identical-code runs); a longer window amortizes them.
             tok_s, ttft, k = _engine_decode_bench(
                 cfg, params, batch, prompt_len=128 if on_tpu else 16,
-                cache_kind="paged",
+                cache_kind="paged", ticks=10,
             )
         except Exception as e:
             err = repr(e)

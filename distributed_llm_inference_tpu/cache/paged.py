@@ -46,6 +46,19 @@ def _table_write(table, pages_row, row, start):
     return jax.lax.dynamic_update_slice(table, pages_row, (row, start))
 
 
+@jax.jit
+def _table_write_batch(table, rows, slots, pages):
+    """N (row, slot) ← page installs in ONE dispatch (scatter over the tiny
+    int32 table; padded entries carry out-of-range rows and drop).
+
+    Why: sequential :func:`_table_write` calls CHAIN (each consumes the
+    previous table), so a growth tick where every row crosses a page
+    boundary pays one tunnel round trip per row — measured ~35 ms × 32 rows
+    ≈ 1.1 s spikes on the serving tick. One batched executable per padded
+    length replaces the chain."""
+    return table.at[rows, slots].set(pages, mode="drop")
+
+
 class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     k_pages: jax.Array
     v_pages: jax.Array
@@ -364,6 +377,30 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             page_table=_table_write(
                 self.page_table, pages[None, :], jnp.int32(row),
                 jnp.int32(start_slot),
+            )
+        )
+
+    def assign_pages_batch(self, rows, slots, pages,
+                           pad_to: int = 0) -> "PagedKVCache":
+        """Install N (row, slot) ← page mappings in ONE device dispatch.
+
+        Sequential :meth:`assign_pages` calls chain through the tunnel (one
+        round trip each); the batched scatter replaces the chain on ticks
+        where many rows grow at once. ``pad_to`` pads the arrays to a
+        fixed length so a few bucketed lengths cover every tick with cached
+        executables; padded entries use a past-the-end row (negative would
+        WRAP) and drop."""
+        n = max(len(rows), pad_to)
+        r = np.full((n,), self.page_table.shape[0], np.int32)
+        s = np.zeros((n,), np.int32)
+        p = np.zeros((n,), np.int32)
+        r[: len(rows)] = rows
+        s[: len(rows)] = slots
+        p[: len(rows)] = pages
+        return self.replace(
+            page_table=_table_write_batch(
+                self.page_table, jnp.asarray(r), jnp.asarray(s),
+                jnp.asarray(p),
             )
         )
 

@@ -193,7 +193,7 @@ def cmd_local(args) -> int:
             speculative_k=args.speculative_k if draft else 0,
             decode_steps=args.decode_steps,
         ),
-        CacheConfig(kind=args.cache),
+        CacheConfig(kind=args.cache, kv_quant=args.kv_quant),
         draft=draft,
     )
     with profile_trace(args.profile_dir):
@@ -308,6 +308,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("paged", "dense", "sink"))
     l.add_argument("--int8", action="store_true")
     l.add_argument("--quantize", default=None, choices=("int8", "int4"))
+    l.add_argument("--kv-quant", default=None, choices=("int8",),
+                   help="int8 KV cache (dense/paged): halves KV HBM "
+                        "traffic; on TPU the dense kind also unlocks the "
+                        "fused Pallas decode kernel (the headline path)")
     l.add_argument("--max-sessions", type=int, default=8)
     l.add_argument("--max-seq-len", type=int, default=2048)
     l.add_argument("--dtype", default="bfloat16")

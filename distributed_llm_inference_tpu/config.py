@@ -183,10 +183,11 @@ class EngineConfig:
     # full max_seq_len buffer (one executable per bucket; big bandwidth win
     # early in long-context serving). None = auto ladder; () disables.
     decode_windows: Optional[Tuple[int, ...]] = None
-    # None (default) = auto: ON for the int8 cache kinds on a real TPU
-    # backend (their fused Pallas decode kernels are the best-known path —
-    # +34% over the XLA two-segment path at the headline config) and OFF
-    # elsewhere (CPU tests would crawl through interpret mode).
+    # None (default) = auto: ON for the int8 DENSE cache on a real TPU
+    # backend (its fused Pallas decode kernel is the best-known path — +40%
+    # through the engine at the headline config); OFF elsewhere — the paged
+    # variant wins at MHA b64 but loses at small-batch GQA, and CPU tests
+    # would crawl through interpret mode.
     use_pallas_attention: Optional[bool] = None
     # Tokens decoded per device dispatch (lax.scan over the decode step with
     # sampling, EOS and per-row token budgets all in-graph). Each host→device

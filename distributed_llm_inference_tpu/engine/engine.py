@@ -76,6 +76,7 @@ class InferenceEngine:
         as a plain 1-token decode via per-row ``num_new`` masking). Output
         is identical to non-speculative greedy decoding."""
         self.cfg = cfg
+        self._mesh_cfg = mesh_cfg
         self.ecfg = engine_cfg or EngineConfig()
         if self.ecfg.quantization in ("int8", "int4"):
             from ..ops.quant import quantize_params
@@ -110,6 +111,11 @@ class InferenceEngine:
         # must not stall on that; they rely on GIL-atomic deque/dict ops and
         # state flags the scheduler observes at tick boundaries.
         self._lock = threading.Lock()
+        # Deferred page-table installs: (row, slot_idx, page) triples batched
+        # into ONE scatter dispatch (sequential assign_pages calls CHAIN —
+        # each consumes the previous table — so a growth tick where every row
+        # crosses a page boundary paid one ~35 ms tunnel round trip per row).
+        self._pending_installs: List[Tuple[int, int, int]] = []
 
         self.batch = self.ecfg.max_batch_size
         dtype = jnp.dtype(self.ecfg.dtype)
@@ -586,10 +592,51 @@ class InferenceEngine:
         page growth after creation, a table widen, or a re-shard stalls a
         decode tick."""
         if isinstance(self.cache, PagedKVCache):
-            # DISCARD the result: we only want the executable compiled; the
-            # write itself would stomp a live row's first page mapping when
-            # re-warming after a mid-serving table widen.
+            # DISCARD the results: we only want the executables compiled;
+            # the writes themselves would stomp a live row's first page
+            # mapping when re-warming after a mid-serving table widen.
             self.cache.assign_pages(0, [0])
+            if self._mesh_cfg is None:
+                # Both batched-install pad buckets (_flush_installs) —
+                # mesh engines never dispatch these (their installs stay
+                # on the chained per-page path), so don't compile them.
+                for pad in {4, self._install_bucket()}:
+                    self.cache.assign_pages_batch([0], [0], [0], pad_to=pad)
+
+    def _install_bucket(self) -> int:
+        """Large flush-pad bucket: covers a growth tick (<= one install per
+        row) and any admission's prompt pages in one cached executable."""
+        n = max(self.batch, self.ccfg.max_pages_per_session)
+        pad = 4
+        while pad < n:
+            pad *= 2
+        return pad
+
+    def _queue_install(self, row: int, slot_idx: int, page: int) -> None:
+        """Defer a page-table install; :meth:`_flush_installs` applies every
+        pending one in a single batched dispatch. Mesh-sharded tables keep
+        the chained per-page path (a scatter over a sharded table aborts
+        under GSPMD)."""
+        if getattr(self, "mesh", None) is not None:
+            self.cache = self.cache.assign_pages(row, [page], slot_idx)
+            return
+        self._pending_installs.append((row, slot_idx, page))
+
+    def _flush_installs(self) -> None:
+        if not self._pending_installs:
+            return
+        rows = [r for r, _, _ in self._pending_installs]
+        slots_ = [si for _, si, _ in self._pending_installs]
+        pages = [p for _, _, p in self._pending_installs]
+        self._pending_installs = []
+        # Exactly TWO pad buckets (both pre-compiled by _warm_table_write):
+        # small flushes (one admission's prompt pages) and everything else.
+        # Arbitrary pow2 pads would each compile mid-serving the first time
+        # a new length appeared (~2 s remote-compile stall).
+        pad = 4 if len(rows) <= 4 else self._install_bucket()
+        self.cache = self.cache.assign_pages_batch(
+            rows, slots_, pages, pad_to=pad
+        )
 
     def _reshard_cache(self) -> None:
         """Re-apply the mesh shardings after a growth/shrink re-created the
@@ -769,6 +816,9 @@ class InferenceEngine:
             self._reshard_cache()
 
     def _admit(self, produced) -> None:
+        # Installs queued by a tick that ended up dispatching nothing must
+        # land before _shrink_if_idle can rebuild (and re-shape) the table.
+        self._flush_installs()
         # Reap sessions cancelled since the last tick (cancel() is
         # non-blocking and only sets the flag).
         for slot, gid in enumerate(self.slots):
@@ -825,15 +875,13 @@ class InferenceEngine:
                         self.allocator.free(shared)  # return the refs
                     break  # pool pressure: hold the queue, retry next tick
                 s.pages = shared + self.allocator.alloc(need - len(shared))
-                # One page per install: reuses the 1-page executable
-                # ``_warm_table_write`` pre-compiled. A whole-run install
-                # compiles a fresh executable per distinct prompt page
-                # count — a ~2 s remote-compile stall per new length the
-                # first time it admits.
+                # Queue the prompt's pages; _flush_installs applies them
+                # in ONE pow2-padded scatter dispatch right before the
+                # prefill (chained per-page installs paid one tunnel round
+                # trip each; per-length whole-run executables paid a ~2 s
+                # remote compile per new prompt page count).
                 for i, pg in enumerate(s.pages):
-                    self.cache = self.cache.assign_pages(
-                        slot, [pg], start_slot=i
-                    )
+                    self._queue_install(slot, i, pg)
                 shared_len = len(shared) * ps
                 if shared_len:
                     self.cache = self.cache.replace(
@@ -871,6 +919,7 @@ class InferenceEngine:
         Prompts past the ring threshold on an ``sp>1`` engine prefill
         sequence-sharded over the ring instead (one dispatch for the whole
         prompt; each sp device computes ``bucket/sp`` positions)."""
+        self._flush_installs()  # prefill writes through the page table
         chunk_cap = self._max_chunk()
         prompt = np.asarray(s.prompt, np.int32)
         sp = SamplingParams.create(
@@ -1028,6 +1077,7 @@ class InferenceEngine:
                 jnp.asarray(fresh), self._carry, jnp.asarray(use_carry)
             )
         act_dev = jnp.asarray(active)
+        self._flush_installs()
         with self.metrics.timer("decode_step"), span(
             "decode_step", self.spans, batch=int(active.sum()),
         ):
@@ -1141,6 +1191,7 @@ class InferenceEngine:
             ))
 
         sp = SamplingParams.stack(opts)
+        self._flush_installs()
         with self.metrics.timer("decode_step"), span(
             "decode_step", self.spans, batch=int(active.sum()),
         ):
@@ -1189,9 +1240,7 @@ class InferenceEngine:
             # (a clamped update would corrupt another slot).
             self._ensure_capacity(len(s.pages) * ps + 1)
             new = self.allocator.alloc(1)
-            self.cache = self.cache.assign_pages(
-                s.slot, new, start_slot=len(s.pages)
-            )
+            self._queue_install(s.slot, len(s.pages), new[0])
             s.pages.extend(new)
         return len(s.pages) * ps
 
@@ -1277,6 +1326,7 @@ class InferenceEngine:
             np.int32
         )
         sp = SamplingParams.stack(opts)
+        self._flush_installs()
         with self.metrics.timer("decode_step"), span(
             "speculative_step", self.spans, batch=int(active.sum()),
         ):

@@ -31,6 +31,9 @@ def main():
     ctx = int(sys.argv[3]) if len(sys.argv) > 3 else 256
     scan_k = int(sys.argv[4]) if len(sys.argv) > 4 else 16
     build, _, cache_cls = bench.PHASES[phase]
+    use_kernel = cache_cls == "dense_kernel"
+    if use_kernel:
+        cache_cls = QuantizedDenseKVCache
     cfg = bench.LLAMA2_7B
     params = build(cfg, jnp.bfloat16)
     jax.block_until_ready(params)
@@ -39,7 +42,7 @@ def main():
     buf = min(ctx, ctx // 2 + writes)
     cache = cache_cls.create(
         cfg.num_layers, batch, buf, cfg.num_kv_heads, cfg.head_dim,
-        jnp.bfloat16,
+        jnp.bfloat16, **({"use_kernel": True} if use_kernel else {}),
     )
     cache = cache.replace(lengths=jnp.full((batch,), ctx // 2, jnp.int32))
     active = jnp.ones((batch,), bool)
