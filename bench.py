@@ -378,32 +378,34 @@ def _try_sink_decode_bench(cfg, params, batch, window, sinks=4, steps=32,
                            scan_k=16):
     """Decode throughput of the SINK ring cache mid-stream (ring full, every
     step evicts) — the reference's signature StreamingLLM capability
-    (``/root/reference/distributed_llm_inference/models/llama/cache.py:111-133``)
-    had no TPU number before r3. No tail path exists for the ring (it evicts
-    on write), so K steps fuse via an in-graph scan of ``model_apply``."""
-    from distributed_llm_inference_tpu.cache.sink import SinkKVCache
+    (``/root/reference/distributed_llm_inference/models/llama/cache.py:111-133``).
+    r4: the int8 ``QuantizedSinkKVCache`` serves the same fused
+    write-behind-tail path as the dense cache (keys stored abs-rotated,
+    eviction is an in-kernel mask — ``cache/sink.py``), replacing r3's bf16
+    per-step re-rotation scan (108 tok/s at this window)."""
+    from distributed_llm_inference_tpu.cache.sink import QuantizedSinkKVCache
 
-    cache = SinkKVCache.create(
+    on_tpu = jax.default_backend() == "tpu"
+    cache = QuantizedSinkKVCache.create(
         cfg.num_layers, batch, window, sinks, cfg.num_kv_heads, cfg.head_dim,
-        jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
+        use_kernel=on_tpu,
     )
     # Mid-stream state: the ring has wrapped (seen > window), so every timed
-    # write exercises the eviction + window-relative re-rotation path.
-    cache = cache.replace(seen=jnp.full((batch,), window + 7, jnp.int32))
-    num_new = jnp.ones((batch,), jnp.int32)
-    donate = {"donate_argnums": (2,)} if jax.default_backend() == "tpu" else {}
+    # step exercises the eviction masking + mod-ring flush path.
+    cache = cache.replace(lengths=jnp.full((batch,), window + 7, jnp.int32))
+    active = jnp.ones((batch,), bool)
+    donate = {"donate_argnums": (2,)} if on_tpu else {}
 
     def decode(params, tokens, cache):
-        def one(carry, _):
-            tok, c = carry
-            logits, c = llama.model_apply(cfg, params, tok, c, num_new)
-            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)[:, None]
-            return (nxt, c), None
+        def step_fn(i, logits, alive):
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return nxt, alive.astype(jnp.int32), alive, nxt
 
-        (tok, cache), _ = jax.lax.scan(
-            one, (tokens, cache), None, length=scan_k
+        emits, cache = llama.multi_decode_apply(
+            cfg, params, tokens, cache, scan_k, step_fn, active,
+            active.astype(jnp.int32),
         )
-        return tok, cache
+        return emits[-1][:, None], cache
 
     decode = jax.jit(decode, **donate)
     tokens = jnp.zeros((batch, 1), jnp.int32)
@@ -424,7 +426,7 @@ def _sink_phase() -> dict:
     jax.block_until_ready(params)
     window = 1024 if on_tpu else 32
     err, best = None, None
-    for batch in ((16, 8, 4) if on_tpu else (4,)):
+    for batch in ((32, 24, 16, 8) if on_tpu else (4,)):
         try:
             tok_s = _try_sink_decode_bench(cfg, params, batch, window)
         except Exception as e:
@@ -436,7 +438,8 @@ def _sink_phase() -> dict:
         raise RuntimeError(f"all sink configs failed: {err}")
     return {
         "tok_s": round(best[0], 2), "batch": best[1], "ttft_ms": None,
-        "window": window, "backend": jax.default_backend(),
+        "window": window, "cache": "sink+int8",
+        "backend": jax.default_backend(),
         "device": str(jax.devices()[0].device_kind),
         "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
     }
@@ -531,7 +534,10 @@ PHASES = {
                     "dense_kernel"),
     "int8_kvq_2k": (_zero_qparams, ((12, 4096), (8, 4096), (4, 4096)),
                     "dense_kernel"),
-    "paged_kvq_1k": (_zero_qparams, ((16, 2048), (12, 2048), (8, 2048)),
+    # r4: past INPLACE_CTX the fused window reads the pool IN PLACE via the
+    # whole-pool kernel (no gather, no second KV copy) — the batch that fits
+    # matches dense (the r3 gather capped this phase at b8).
+    "paged_kvq_1k": (_zero_qparams, ((24, 2048), (16, 2048), (12, 2048)),
                      "paged_kvq"),
     # StreamingLLM sink ring mid-stream (signature feature) — _sink_phase().
     "sink_1k": None,

@@ -708,11 +708,41 @@ class QuantizedPagedKVCache(PagedKVCache):
     # fixed per-step cost. Amortized over K steps the gather is ~2% of a
     # step; the pool itself stays read-only until ``tail_flush`` scatters the
     # window back.
+    #
+    # r4: PAST the context threshold below, the fused window reads the pool
+    # in place again — but through ``quantized_paged_fused_attention``, which
+    # takes the WHOLE ``[L, P, …]`` pool with the layer resolved in its block
+    # index map (zero-copy, the mechanism r2 lacked) and the tail io-aliased
+    # in-kernel. At long contexts the r3 gather's second contiguous copy of
+    # the live KV was the binding constraint: it halved the admissible batch
+    # (paged_kvq_1k capped at b8 while dense served b24) and re-copied the
+    # whole working set every window.
+
+    #: TABLE CAPACITY (``max_len`` = table width x page size) at/above which
+    #: the fused window switches from gather-per-window to the in-place
+    #: whole-pool kernel. The switch must be static per executable, so it
+    #: keys on capacity — a faithful proxy for live context under the
+    #: engine's growth ladder, which widens the table bucket-by-bucket as
+    #: sessions lengthen (grow-disabled mesh configs sit at full capacity
+    #: and always take the in-place form, a conservative choice). Below the
+    #: threshold the gathered form wins (r3 measurement: +40% at 256-token
+    #: contexts, where the gather is cheap and row-blocked 256-wide tiles
+    #: beat per-page DMAs); above it the gather's second copy of the live
+    #: KV dominates (halved admissible batch at 1k ctx).
+    INPLACE_CTX = 768
+
+    @property
+    def _fused_inplace(self) -> bool:
+        return self.use_kernel and self.max_len >= self.INPLACE_CTX
 
     def tail_big_stacks(self):
-        """Contiguous head-major gather of every row's table span:
+        """Read-only stacks for the fused window: past ``INPLACE_CTX`` the
+        whole pool planes (in-place kernel); below it a contiguous
+        head-major gather of every row's table span:
         ``(k [L,B,Hkv,Tmax,D] int8, v, ks [L,B,Hkv,Tmax] f32, vs)``. Unmapped
         table slots read the null page — masked by ``pos < base_len``."""
+        if self._fused_inplace:
+            return (self.k_pages, self.v_pages, self.ks_pages, self.vs_pages)
         table = self.page_table  # [B, T]
 
         def g(pages):  # [L, P, H, PS, D] → [L, B, H, T*PS, D]
@@ -772,12 +802,28 @@ class QuantizedPagedKVCache(PagedKVCache):
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
         if self.use_kernel and q.shape[1] == 1:
+            gk, gv, gks, gvs, lidx = big_state  # whole [L, ...] + layer idx
+            tk, tv, tks, tvs = tail_state
+            if self._fused_inplace:
+                from ..ops.paged_attention import (
+                    quantized_paged_fused_attention,
+                )
+
+                out, ntk, ntks, ntv, ntvs = quantized_paged_fused_attention(
+                    q_rot, k_rot, v_new,
+                    gk, gks, gv, gvs,
+                    tk, tks, tv, tvs,
+                    layer_idx=lidx, step_idx=step_idx,
+                    page_table=self.page_table, base_len=base_len,
+                    tail_valid_len=tail_len + num_new,
+                    q_positions=base_len + tail_len,
+                    scale=scale, sliding_window=sliding_window,
+                )
+                return out, (ntk, ntv, ntks, ntvs)
             from ..ops.quant_attention import (
                 quantized_fused_decode_attention,
             )
 
-            gk, gv, gks, gvs, lidx = big_state  # whole [L, ...] + layer idx
-            tk, tv, tks, tvs = tail_state
             out, ntk, ntks, ntv, ntvs = quantized_fused_decode_attention(
                 q_rot, k_rot, v_new,
                 gk, gks, gv, gvs,
@@ -816,6 +862,24 @@ class QuantizedPagedKVCache(PagedKVCache):
         num_new = tail_len
         if len(tail) == 4:  # kernel mode: pre-quantized int8 + scales
             wk, wv, wks, wvs = tail  # [L, B, Hkv, K, D] / [L, B, Hkv, K]
+            if kk <= self.page_size:
+                # Blocked page RMW (Pallas): the XLA scatter below prefers a
+                # transposed pool layout, making XLA insert a whole-pool
+                # relayout copy into the fused-decode executable (2x3.2 GB
+                # HLO temp at b24 1k-ctx 7B — an OOM; a silent bandwidth tax
+                # below that).
+                from ..ops.paged_attention import paged_tail_flush
+
+                new_k, new_ks, new_v, new_vs = paged_tail_flush(
+                    self.k_pages, self.ks_pages, self.v_pages, self.vs_pages,
+                    wk, wks, wv, wvs,
+                    self.page_table, self.lengths, tail_len,
+                )
+                return self.replace(
+                    k_pages=new_k, v_pages=new_v,
+                    ks_pages=new_ks, vs_pages=new_vs,
+                    lengths=self.lengths + tail_len,
+                )
             new_k, new_v, new_ks, new_vs = jax.vmap(
                 lambda lk, lv, lks, lvs, tkl, tvl, tksl, tvsl:
                 self._scatter_planes(

@@ -29,15 +29,17 @@ contract, as with ``DenseKVCache.fits``).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..ops.attention import causal_mask
+from ..ops.attention import _NEG_INF, causal_mask
 from ..ops.rotary import RopeAngles, apply_rope, rope_cos_sin
 from .base import GatherAttendMixin
+from .dense import _DenseRowsMixin, _quantize_kv
 
 
 class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
@@ -199,4 +201,472 @@ class SinkKVCache(GatherAttendMixin, struct.PyTreeNode):
             k=jax.lax.dynamic_update_slice_in_dim(self.k, sub.k, row, axis=1),
             v=jax.lax.dynamic_update_slice_in_dim(self.v, sub.v, row, axis=1),
             seen=jax.lax.dynamic_update_slice_in_dim(self.seen, sub.seen, row, axis=0),
+        )
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _multi_q_quantized_segments(segments, scale):
+    """Per-segment-query joint softmax (see
+    :func:`ops.attention.gqa_attention_quantized_multi_q_segments`): the
+    sink segment attends with the window-relative-rotated query, the
+    ring/tail segments with the absolute-rotated one."""
+    from ..ops.attention import gqa_attention_quantized_multi_q_segments
+
+    return gqa_attention_quantized_multi_q_segments(segments, scale)
+
+
+class QuantizedSinkKVCache(_DenseRowsMixin, struct.PyTreeNode):
+    """Serving-grade StreamingLLM cache: int8 ring + int8 sinks + fused tail.
+
+    The bf16 :class:`SinkKVCache` stores keys unrotated and re-rotates the
+    WHOLE window to its effective positions inside attention every step —
+    correct, but ~2.6x slower than even the bf16 dense cache at window 1024
+    (round-3 bench). This redesign makes the sink cache structurally
+    identical to :class:`~..cache.dense.QuantizedDenseKVCache` (int8 planes,
+    Pallas fused-decode kernel, write-behind tail) by moving the position
+    bookkeeping out of the data path:
+
+    * RoPE attention scores depend only on position DIFFERENCES
+      (``<R(a)q, R(b)k>`` is a function of ``b - a``), so ring keys are
+      stored rotated at their ABSOLUTE stream positions — written once,
+      never re-rotated — and queries rotate at their absolute position too.
+      Window scores match the reference's window-relative convention
+      (``/root/reference/distributed_llm_inference/models/llama/cache.py:111-133``)
+      exactly, in exact arithmetic.
+    * Only the ``num_sinks`` sink tokens have COMPRESSED positions (the
+      StreamingLLM trick that keeps query-to-sink distances bounded): they
+      are stored rotated at their fixed slots ``0..s-1`` and attended with
+      a SECOND query rotated at the window-relative position
+      ``min(q_pos, window - 1)`` — one extra tiny rotation per step instead
+      of a whole-window re-rotation.
+    * Eviction is a mask, not data movement: ring slot ``j``'s occupant is
+      derivable from ``lengths``; the slots the in-flight fused tail has
+      logically evicted are masked in-kernel (exact per-step window
+      semantics) and physically overwritten at flush (mod-ring blocked RMW,
+      ``ops/quant_attention.py:sink_tail_flush``).
+
+    ``k``/``v``: int8 ``[L, B, Hkv, TR, D]`` head-major ring (TR = ring
+    span padded to 32); ``ks``/``vs``: f32 scales; ``sk``/``sv``/
+    ``sks``/``svs``: the sink planes ``[L, B, Hkv, SP, D]`` (SP = 32);
+    ``lengths``: total stream length per row (the bf16 class calls it
+    ``seen``).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    ks: jax.Array
+    vs: jax.Array
+    sk: jax.Array
+    sv: jax.Array
+    sks: jax.Array
+    svs: jax.Array
+    lengths: jax.Array
+    num_sinks: int = struct.field(pytree_node=False)
+    ring_slots: int = struct.field(pytree_node=False)
+    use_kernel: bool = struct.field(pytree_node=False, default=False)
+
+    BATCH_AXES = {
+        "k": 1, "v": 1, "ks": 1, "vs": 1,
+        "sk": 1, "sv": 1, "sks": 1, "svs": 1, "lengths": 0,
+    }
+    LAYER_FIELDS = ("k", "v", "ks", "vs", "sk", "sv", "sks", "svs")
+    SINK_PAD = 32
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        batch: int,
+        window_length: int,
+        num_sink_tokens: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,  # interface parity; values are int8
+        use_kernel: bool = False,
+    ) -> "QuantizedSinkKVCache":
+        if not 0 <= num_sink_tokens < window_length:
+            raise ValueError("need 0 <= num_sink_tokens < window_length")
+        r = window_length - num_sink_tokens
+        tr = max(32, _round_up(r, 32))
+        sp = QuantizedSinkKVCache.SINK_PAD
+        shape = (num_layers, batch, num_kv_heads, tr, head_dim)
+        sshape = (num_layers, batch, num_kv_heads, sp, head_dim)
+        return QuantizedSinkKVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            ks=jnp.zeros(shape[:-1], jnp.float32),
+            vs=jnp.zeros(shape[:-1], jnp.float32),
+            sk=jnp.zeros(sshape, jnp.int8),
+            sv=jnp.zeros(sshape, jnp.int8),
+            sks=jnp.zeros(sshape[:-1], jnp.float32),
+            svs=jnp.zeros(sshape[:-1], jnp.float32),
+            lengths=jnp.zeros((batch,), jnp.int32),
+            num_sinks=num_sink_tokens,
+            ring_slots=r,
+            use_kernel=use_kernel,
+        )
+
+    # -- geometry -------------------------------------------------------------
+
+    @property
+    def window(self) -> int:
+        return self.ring_slots + self.num_sinks
+
+    @property
+    def seen(self) -> jax.Array:
+        """bf16-class-compatible alias (total stream length per row)."""
+        return self.lengths
+
+    @property
+    def layer_stacks(self):
+        return (self.k, self.v, self.ks, self.vs,
+                self.sk, self.sv, self.sks, self.svs)
+
+    def with_layer_stacks(self, k, v, ks, vs, sk, sv, sks, svs):
+        return self.replace(k=k, v=v, ks=ks, vs=vs,
+                            sk=sk, sv=sv, sks=sks, svs=svs)
+
+    def fits(self, num_new) -> jnp.ndarray:
+        """Never overflows; chunks must fit the ring span (engine
+        contract, as with the bf16 class)."""
+        return jnp.broadcast_to(
+            jnp.asarray(num_new) <= self.ring_slots, self.lengths.shape
+        )
+
+    def grow_to(self, new_len: int):
+        raise TypeError("the sink ring is fixed-size; nothing to grow")
+
+    # -- position bookkeeping -------------------------------------------------
+
+    def _ring_kv_positions(self, total: jnp.ndarray):
+        """Absolute position held by each ring slot after ``total`` stream
+        tokens (latest write wins) + liveness: ``(pos [B, TR], live)``."""
+        s, r = self.num_sinks, self.ring_slots
+        tr = self.k.shape[3]
+        slot = jnp.arange(tr, dtype=jnp.int32)[None, :]
+        n = total[:, None]
+        m = (n - 1 - s - slot) // r
+        pos = s + slot + jnp.maximum(m, 0) * r
+        live = (slot < r) & (pos < n)
+        return pos, live
+
+    def _eff_query(self, q, q_pos, total, inv_freq):
+        """Rotate ``q`` at its window-relative position (for sink scores):
+        ``eff = q_pos - (oldest - s)`` with ``oldest`` framed by ``total``
+        (chunk-granular eviction, matching the bf16 class)."""
+        s, r = self.num_sinks, self.ring_slots
+        oldest = jnp.maximum(s, total - r)
+        eff = q_pos - (oldest - s)[:, None]
+        cos, sin = rope_cos_sin(eff, inv_freq)
+        return apply_rope(q, cos, sin)
+
+    # -- writes ---------------------------------------------------------------
+
+    def _ring_write(self, layer_buf, new_vals, num_new):
+        """Merge incoming ``[B, S, Hkv(, D)]`` rows into the head-major ring
+        ``[B, Hkv, TR(, D)]`` at mod-``ring_slots`` slots. Gather+select
+        (SPMD-friendly): ring slot ``t`` takes the LAST chunk token landing
+        on it — chunk index ``i ≡ t - (lengths - s) (mod r)`` maximal with
+        ``i < num_new`` and stream position ``>= s``."""
+        s, r = self.num_sinks, self.ring_slots
+        b, sl = new_vals.shape[:2]
+        tr = layer_buf.shape[2]
+        nv = jnp.moveaxis(new_vals, 1, 2)  # [B, Hkv, S(, D)]
+        t = jnp.arange(tr, dtype=jnp.int32)[None, :]
+        a = (self.lengths - s)[:, None]  # may be negative (sink phase)
+        cand = jnp.mod(t - a, r)
+        # Largest i ≡ cand (mod r) below num_new (covers multi-wrap chunks).
+        i = cand + jnp.maximum(
+            (num_new[:, None] - 1 - cand) // r, 0
+        ) * r
+        take = (
+            (t < r)
+            & (i < num_new[:, None])
+            & (a + i >= 0)  # stream position >= s (not sink-bound)
+        )
+        extra = nv.ndim - 3
+        idx = jnp.clip(i, 0, sl - 1).reshape(b, 1, tr, *([1] * extra))
+        sel = take.reshape(b, 1, tr, *([1] * extra))
+        return jnp.where(
+            sel, jnp.take_along_axis(nv, idx, axis=2), layer_buf
+        )
+
+    def _sink_write(self, layer_buf, new_vals, num_new):
+        """Sink slot ``j`` takes chunk token ``j - lengths`` when that token
+        exists (stream positions below ``num_sinks`` — keys rotated at their
+        absolute position, which IS the sink slot)."""
+        s = self.num_sinks
+        b, sl = new_vals.shape[:2]
+        sp = layer_buf.shape[2]
+        nv = jnp.moveaxis(new_vals, 1, 2)  # [B, Hkv, S(, D)]
+        j = jnp.arange(sp, dtype=jnp.int32)[None, :]
+        i = j - self.lengths[:, None]
+        take = (j < s) & (i >= 0) & (i < num_new[:, None])
+        extra = nv.ndim - 3
+        idx = jnp.clip(i, 0, sl - 1).reshape(b, 1, sp, *([1] * extra))
+        sel = take.reshape(b, 1, sp, *([1] * extra))
+        return jnp.where(
+            sel, jnp.take_along_axis(nv, idx, axis=2), layer_buf
+        )
+
+    # -- attention ------------------------------------------------------------
+
+    def attend(
+        self,
+        layer_state,
+        q,
+        k_new,
+        v_new,
+        rope,
+        q_pos,
+        num_new,
+        sliding_window,
+        attention_fn,
+        scale=None,
+    ):
+        """Prefill and per-step decode: quantize the chunk (keys rotated at
+        ABSOLUTE positions), write ring (mod) + sink (prefix) planes, run
+        the three-segment joint softmax. ``attention_fn`` is ignored — the
+        segments math is the cache's own (the engine never swaps attention
+        for own-kernel caches); ``sliding_window`` is ignored — the ring is
+        the window policy."""
+        (layer_k, layer_v, layer_ks, layer_vs,
+         layer_sk, layer_sv, layer_sks, layer_svs) = layer_state
+        s = self.num_sinks
+        total = self.lengths + num_new
+
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        k_q, k_s = _quantize_kv(k_rot)
+        v_q, v_s = _quantize_kv(v_new)
+
+        new_k = self._ring_write(layer_k, k_q, num_new)
+        new_v = self._ring_write(layer_v, v_q, num_new)
+        new_ks = self._ring_write(layer_ks, k_s, num_new)
+        new_vs = self._ring_write(layer_vs, v_s, num_new)
+        new_sk = self._sink_write(layer_sk, k_q, num_new)
+        new_sv = self._sink_write(layer_sv, v_q, num_new)
+        new_sks = self._sink_write(layer_sks, k_s, num_new)
+        new_svs = self._sink_write(layer_svs, v_s, num_new)
+        new_state = (new_k, new_v, new_ks, new_vs,
+                     new_sk, new_sv, new_sks, new_svs)
+
+        q_eff = self._eff_query(q, q_pos, total, rope.inv_freq)
+
+        kv_pos, kv_live = self._ring_kv_positions(total)
+        ring_mask = causal_mask(q_pos, kv_pos, kv_live)
+
+        sp = layer_sk.shape[2]
+        sink_idx = jnp.broadcast_to(
+            jnp.arange(sp, dtype=jnp.int32)[None, :], (q.shape[0], sp)
+        )
+        sink_live = sink_idx < jnp.minimum(total, s)[:, None]
+        sink_mask = causal_mask(q_pos, sink_idx, sink_live)
+
+        out = _multi_q_quantized_segments(
+            [
+                (q_eff, new_sk, new_sks, new_sv, new_svs, sink_mask),
+                (q_rot, new_k, new_ks, new_v, new_vs, ring_mask),
+            ],
+            scale,
+        )
+        return out, new_state
+
+    # -- write-behind tail (fused multi-step decode) --------------------------
+
+    @property
+    def tail_reads_whole_big(self) -> bool:
+        return self.use_kernel
+
+    @property
+    def tail_in_kernel(self) -> bool:
+        return self.use_kernel
+
+    def tail_init(self, k_steps: int):
+        l, b, h, _, d = self.k.shape
+        zs = jnp.zeros((l, b, h, k_steps), jnp.float32)
+        if self.use_kernel:
+            return (
+                jnp.zeros((l, b, h, k_steps, d), jnp.int8),
+                jnp.zeros((l, b, h, k_steps, d), jnp.int8),
+                zs,
+                jnp.zeros((l, b, h, k_steps), jnp.float32),
+            )
+        zq = jnp.zeros((l, b, h, k_steps, d), jnp.int8)
+        return (zq, zq, zs, zs)
+
+    def _tail_scalars(self, base_len, tail_len, num_new):
+        s, r = self.num_sinks, self.ring_slots
+        ring_len = jnp.clip(base_len - s, 0, r)
+        ring_ptr = jnp.mod(jnp.maximum(base_len - s, 0), r)
+        # Ring tokens evicted so far INCLUDING by the token being appended
+        # this step: the post-append window is [total - r, total) with
+        # total = base + tail_len + num_new, so the oldest
+        # ``tail_len + num_new`` ring slots are dead (an ``evict = tail_len``
+        # off-by-one leaves the current step's victim attended — caught by a
+        # 0.009 logit gap vs per-step decode on a fully wrapped ring).
+        evict = tail_len + num_new
+        sink_len = jnp.minimum(base_len, s)
+        vlen = tail_len + num_new
+        return ring_len, ring_ptr, evict, sink_len, vlen
+
+    def tail_attend(self, big_state, tail_state, q, k_new, v_new, rope,
+                    base_len, tail_len, step_idx, num_new, sliding_window,
+                    scale=None):
+        """Three-segment decode attention (sink + ring + tail); the big
+        planes stay read-only, the step's K/V is quantized into the tail at
+        scalar slot ``step_idx`` (in-kernel when ``use_kernel``)."""
+        s = self.num_sinks
+        q_pos = base_len + tail_len
+        q_rot = apply_rope(q, rope.cos, rope.sin)
+        k_rot = apply_rope(k_new, rope.cos, rope.sin)
+        # Window-relative query for the sink segment, framed at the
+        # post-step total (q_pos + 1), as token-by-token decode demands.
+        q_eff = self._eff_query(
+            q, q_pos[:, None], q_pos + 1, rope.inv_freq
+        )
+        ring_len, ring_ptr, evict, sink_len, vlen = self._tail_scalars(
+            base_len, tail_len, num_new
+        )
+
+        if self.use_kernel and q.shape[1] == 1:
+            from ..ops.quant_attention import sink_fused_decode_attention
+
+            (big_k, big_v, big_ks, big_vs,
+             big_sk, big_sv, big_sks, big_svs) = big_state[:8]
+            tk, tv, tks, tvs = tail_state
+            out, ntk, ntks, ntv, ntvs = sink_fused_decode_attention(
+                q_rot, q_eff, k_rot, v_new,
+                big_k, big_ks, big_v, big_vs,
+                big_sk, big_sks, big_sv, big_svs,
+                tk, tks, tv, tvs,
+                layer_idx=big_state[8], step_idx=step_idx,
+                ring_len=ring_len, ring_ptr=ring_ptr, evict_len=evict,
+                sink_len=sink_len, tail_valid_len=vlen,
+                ring_slots=self.ring_slots, scale=scale,
+            )
+            return out, (ntk, ntv, ntks, ntvs)
+
+        (big_k, big_v, big_ks, big_vs,
+         big_sk, big_sv, big_sks, big_svs) = big_state[:8]
+        tk, tv, tks, tvs = tail_state
+        k_q, k_s = _quantize_kv(k_rot)   # [B, 1, Hkv, D] / [B, 1, Hkv]
+        v_q, v_s = _quantize_kv(v_new)
+        tk = jax.lax.dynamic_update_slice_in_dim(
+            tk, jnp.moveaxis(k_q, 1, 2), step_idx, axis=2
+        )
+        tv = jax.lax.dynamic_update_slice_in_dim(
+            tv, jnp.moveaxis(v_q, 1, 2), step_idx, axis=2
+        )
+        tks = jax.lax.dynamic_update_slice_in_dim(
+            tks, jnp.moveaxis(k_s, 1, 2), step_idx, axis=2
+        )
+        tvs = jax.lax.dynamic_update_slice_in_dim(
+            tvs, jnp.moveaxis(v_s, 1, 2), step_idx, axis=2
+        )
+
+        b = q.shape[0]
+        r = self.ring_slots
+        tr = big_k.shape[2]
+        slot = jnp.broadcast_to(
+            jnp.arange(tr, dtype=jnp.int32)[None, :], (b, tr)
+        )
+        dd = slot - ring_ptr[:, None]
+        dd = dd + jnp.where(dd < 0, r, 0)
+        ring_valid = (
+            (slot < ring_len[:, None]) & (dd >= evict[:, None])
+        )[:, None, :]
+        sp = big_sk.shape[2]
+        sidx = jnp.broadcast_to(
+            jnp.arange(sp, dtype=jnp.int32)[None, :], (b, sp)
+        )
+        sink_valid = (sidx < sink_len[:, None])[:, None, :]
+        kt = tk.shape[2]
+        tidx = jnp.broadcast_to(
+            jnp.arange(kt, dtype=jnp.int32)[None, :], (b, kt)
+        )
+        tail_valid = (tidx < vlen[:, None])[:, None, :]
+
+        out = _multi_q_quantized_segments(
+            [
+                (q_eff, big_sk, big_sks, big_sv, big_svs, sink_valid),
+                (q_rot, big_k, big_ks, big_v, big_vs, ring_valid),
+                (q_rot, tk, tks, tv, tvs, tail_valid),
+            ],
+            scale,
+        )
+        return out, (tk, tv, tks, tvs)
+
+    def tail_flush(self, tail, tail_len):
+        """Physically place the tail: ring tokens via the mod-ring blocked
+        RMW kernel (XLA gather fallback off-kernel), sink-bound tokens (the
+        rare sub-``num_sinks`` stream heads) via a cheap masked merge of the
+        small sink planes; ``lengths`` advances by ``tail_len``."""
+        wk, wv, wks, wvs = tail  # [L, B, Hkv, KT, D] / [L, B, Hkv, KT]
+        s, r = self.num_sinks, self.ring_slots
+        kt = wk.shape[3]
+        skip = jnp.clip(s - self.lengths, 0, kt)
+        ring_ptr = jnp.mod(jnp.maximum(self.lengths - s, 0), r)
+
+        if self.use_kernel and kt <= 32:
+            from ..ops.quant_attention import sink_tail_flush
+
+            nk, nks, nv, nvs = sink_tail_flush(
+                self.k, self.ks, self.v, self.vs, wk, wks, wv, wvs,
+                ring_ptr, skip, tail_len, self.ring_slots,
+            )
+        else:
+            nk, nks, nv, nvs = (
+                self._ring_flush_xla(big, tl, tail_len, skip, ring_ptr)
+                for big, tl in (
+                    (self.k, wk), (self.ks, wks),
+                    (self.v, wv), (self.vs, wvs),
+                )
+            )
+
+        new_sk = self._sink_flush_xla(self.sk, wk, tail_len)
+        new_sv = self._sink_flush_xla(self.sv, wv, tail_len)
+        new_sks = self._sink_flush_xla(self.sks, wks, tail_len)
+        new_svs = self._sink_flush_xla(self.svs, wvs, tail_len)
+        return self.replace(
+            k=nk, v=nv, ks=nks, vs=nvs,
+            sk=new_sk, sv=new_sv, sks=new_sks, svs=new_svs,
+            lengths=self.lengths + tail_len,
+        )
+
+    def _ring_flush_xla(self, big, tl_buf, tail_len, skip, ring_ptr):
+        """Gather+select ring merge: ring slot ``t`` takes the LAST live
+        tail token targeting it (``i ≡ t - ring_ptr + skip (mod r)``)."""
+        r = self.ring_slots
+        b = big.shape[1]
+        tr = big.shape[3]
+        kt = tl_buf.shape[3]
+        t = jnp.arange(tr, dtype=jnp.int32)[None, :]
+        cand = skip[:, None] + jnp.mod(t - ring_ptr[:, None], r)
+        i = cand + jnp.maximum(
+            (tail_len[:, None] - 1 - cand) // r, 0
+        ) * r
+        take = (t < r) & (i >= skip[:, None]) & (i < tail_len[:, None])
+        extra = big.ndim - 4  # 1 for value planes, 0 for scales
+        idx = jnp.clip(i, 0, kt - 1).reshape(1, b, 1, tr, *([1] * extra))
+        sel = take.reshape(1, b, 1, tr, *([1] * extra))
+        return jnp.where(
+            sel, jnp.take_along_axis(tl_buf, idx, axis=3), big
+        )
+
+    def _sink_flush_xla(self, sink_buf, tl_buf, tail_len):
+        s = self.num_sinks
+        b = sink_buf.shape[1]
+        sp = sink_buf.shape[3]
+        kt = tl_buf.shape[3]
+        j = jnp.arange(sp, dtype=jnp.int32)[None, :]
+        i = j - self.lengths[:, None]
+        take = (j < s) & (i >= 0) & (i < tail_len[:, None])
+        extra = sink_buf.ndim - 4
+        idx = jnp.clip(i, 0, kt - 1).reshape(1, b, 1, sp, *([1] * extra))
+        sel = take.reshape(1, b, 1, sp, *([1] * extra))
+        return jnp.where(
+            sel, jnp.take_along_axis(tl_buf, idx, axis=3), sink_buf
         )

@@ -35,7 +35,12 @@ import numpy as np
 from ..cache.base import window_ladder
 from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
 from ..cache.paged import PageAllocator, PagedKVCache, QuantizedPagedKVCache
-from ..cache.sink import SinkKVCache
+from ..cache.sink import QuantizedSinkKVCache, SinkKVCache
+
+# Cache kinds implementing the StreamingLLM sink-window policy (unbounded
+# streams, fixed memory): scheduler paths that special-case the sink ring
+# must cover both the bf16 and the int8/kernel variants.
+_SINK_KINDS = (SinkKVCache, QuantizedSinkKVCache)
 from ..config import CacheConfig, EngineConfig, ModelConfig
 from ..models import llama
 from ..utils.metrics import Metrics
@@ -132,17 +137,19 @@ class InferenceEngine:
             if self.ecfg.use_pallas_attention is not None
             else (
                 jax.default_backend() == "tpu"
-                and cc.kind == "dense"
+                and cc.kind in ("dense", "sink")
                 and cc.kv_quant == "int8"
             )
         )
         self._windows: Tuple[int, ...] = ()
         if cc.kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv_quant {cc.kv_quant!r}")
-        if cc.kv_quant is not None and cc.kind not in ("dense", "paged"):
+        if cc.kv_quant is not None and cc.kind not in (
+            "dense", "paged", "sink"
+        ):
             raise ValueError(
-                f"kv_quant={cc.kv_quant!r} is only supported for the dense "
-                f"and paged caches (got kind={cc.kind!r})"
+                f"kv_quant={cc.kv_quant!r} is only supported for the dense, "
+                f"paged, and sink caches (got kind={cc.kind!r})"
             )
         if cc.prefix_caching and cc.kind != "paged":
             raise ValueError(
@@ -203,10 +210,17 @@ class InferenceEngine:
             self.allocator = PageAllocator(cc.num_pages)
             self._warm_table_write()
         elif cc.kind == "sink":
-            self.cache = SinkKVCache.create(
-                cfg.num_layers, b, cc.window_length, cc.num_sink_tokens,
-                cfg.num_kv_heads, cfg.head_dim, dtype,
-            )
+            if cc.kv_quant == "int8":
+                self.cache = QuantizedSinkKVCache.create(
+                    cfg.num_layers, b, cc.window_length, cc.num_sink_tokens,
+                    cfg.num_kv_heads, cfg.head_dim, dtype,
+                    use_kernel=self._use_pallas,
+                )
+            else:
+                self.cache = SinkKVCache.create(
+                    cfg.num_layers, b, cc.window_length, cc.num_sink_tokens,
+                    cfg.num_kv_heads, cfg.head_dim, dtype,
+                )
             self.allocator = None
         else:
             raise ValueError(f"unknown cache kind {cc.kind}")
@@ -272,7 +286,8 @@ class InferenceEngine:
             attention is None
             and self._use_pallas
             and not isinstance(
-                self.cache, (QuantizedDenseKVCache, PagedKVCache)
+                self.cache,
+                (QuantizedDenseKVCache, PagedKVCache, QuantizedSinkKVCache),
             )
         ):
             # Caches with their OWN kernels (int8 dense, paged) must keep
@@ -341,7 +356,7 @@ class InferenceEngine:
                 isinstance(
                     self.cache,
                     (DenseKVCache, QuantizedDenseKVCache,
-                     QuantizedPagedKVCache),
+                     QuantizedPagedKVCache, QuantizedSinkKVCache),
                 )
                 or (
                     isinstance(self.cache, PagedKVCache)
@@ -349,6 +364,16 @@ class InferenceEngine:
                 )
             )
         )
+        if tail_capable and isinstance(self.cache, QuantizedSinkKVCache):
+            # The fused window must fit the ring span: a tail longer than
+            # the ring would have tail tokens evicting EACH OTHER, which the
+            # tail segment's prefix-validity cannot express. (The bf16 sink
+            # ring is never tail-capable — it has no tail protocol.)
+            k_want = (
+                self.ecfg.decode_steps
+                if self.ecfg.decode_steps is not None else 16
+            )
+            tail_capable = self.cache.ring_slots >= max(1, k_want)
         # decode_steps=None (the default) resolves to the fused fast path
         # wherever it composes: the engine should serve its best configuration
         # out of the box, not behind a flag.
@@ -465,7 +490,7 @@ class InferenceEngine:
             dcfg, dparams = draft
             if dcfg.vocab_size != cfg.vocab_size:
                 raise ValueError("draft and target must share a vocabulary")
-            if isinstance(self.cache, SinkKVCache):
+            if isinstance(self.cache, _SINK_KINDS):
                 raise ValueError(
                     "speculative decoding needs rollback-capable caches "
                     "(dense/paged); the sink ring evicts on write"
@@ -537,6 +562,18 @@ class InferenceEngine:
             # proposals, which the host fetches after dispatch).
             vdk = dict(donate_argnums=(4,)) if donate else {}
             self._verify = self._with_mesh(jax.jit(_verify, **vdk))
+
+    def _sink_cap(self) -> int:
+        """Stream-length bound for sink sessions. The bf16 ring rotates at
+        window-relative (bounded) positions, so its streams are limited only
+        by the int32 ``seen`` counter; the quantized ring stores keys rotated
+        at ABSOLUTE positions, whose f32 RoPE angles (``pos * inv_freq``)
+        lose ~``pos * 6e-8`` rad of precision on the highest-frequency
+        channel — bound streams at 2^20 tokens (~0.06 rad worst-case drift)
+        rather than let attention quality decay silently."""
+        return (1 << 20) if isinstance(
+            self.cache, QuantizedSinkKVCache
+        ) else (1 << 30)
 
     def _window_ladder(
         self, cap: Optional[int] = None, strict: bool = True
@@ -766,7 +803,7 @@ class InferenceEngine:
 
     def _max_chunk(self) -> int:
         """Largest prefill chunk the cache accepts (sink ring constraint)."""
-        if isinstance(self.cache, SinkKVCache):
+        if isinstance(self.cache, _SINK_KINDS):
             return min(
                 self.ecfg.prefill_buckets[-1],
                 self.ccfg.window_length - self.ccfg.num_sink_tokens,
@@ -774,7 +811,7 @@ class InferenceEngine:
         return self.ecfg.prefill_buckets[-1]
 
     def _capacity_ok(self, s: Session) -> bool:
-        if isinstance(self.cache, SinkKVCache):
+        if isinstance(self.cache, _SINK_KINDS):
             return True
         limit = (
             self.ecfg.max_seq_len
@@ -1027,6 +1064,7 @@ class InferenceEngine:
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
         budget = np.zeros((self.batch,), np.int32)
         paged = isinstance(self.cache, PagedKVCache)
+        sink = isinstance(self.cache, _SINK_KINDS)
         for slot, gid in enumerate(self.slots):
             if gid is None:
                 continue
@@ -1035,10 +1073,12 @@ class InferenceEngine:
             fresh[slot, 0] = s.last_token
             use_carry[slot] = self._carry_ok[slot]
             pend = int(pend_b[slot])
-            cap = (
-                self.ecfg.max_seq_len if not paged
-                else len(s.pages) * self.ccfg.page_size
-            )
+            if sink:  # the ring evicts; streams are (near-)unbounded
+                cap = self._sink_cap()
+            elif paged:
+                cap = len(s.pages) * self.ccfg.page_size
+            else:
+                cap = self.ecfg.max_seq_len
             if pend == 0 and s.total_len + 1 > cap:
                 if paged:
                     # One more growth attempt before declaring capacity.
@@ -1169,13 +1209,18 @@ class InferenceEngine:
                     s.options.max_new_tokens - len(s.generated),
                     self.ecfg.max_seq_len - s.total_len,
                 )
-        else:  # sink ring: unbounded stream
+        else:  # sink ring: (near-)unbounded stream
+            cap = self._sink_cap()
             for slot, gid in enumerate(self.slots):
                 if gid is None:
                     continue
                 s = self.sessions[gid]
+                if s.total_len + 1 > cap:
+                    self._finish(s, "capacity", produced)
+                    continue
                 budget[slot] = min(
-                    K, s.options.max_new_tokens - len(s.generated)
+                    K, s.options.max_new_tokens - len(s.generated),
+                    cap - s.total_len,
                 )
 
         active = np.array(

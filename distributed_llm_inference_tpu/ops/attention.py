@@ -214,6 +214,57 @@ def gqa_attention_segments(
     return (out / denom).reshape(b, s, hq, d).astype(q.dtype)
 
 
+def gqa_attention_quantized_multi_q_segments(
+    segments: Sequence[Tuple[jnp.ndarray, ...]],
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Joint softmax over int8 head-major segments, each with its OWN query
+    and full mask.
+
+    The general form behind :func:`gqa_attention_quantized_segments`, needed
+    by the quantized sink cache: its sink segment is attended with a
+    window-relative-rotated query while the ring/tail segments use the
+    absolute-rotated one (RoPE scores depend only on position differences —
+    ``cache/sink.py``). Each segment is ``(q [B, S, Hq, D], k_q [B, Hkv,
+    Ti, D] int8, ks [B, Hkv, Ti] f32, v_q, vs, mask)`` with ``mask`` either
+    ``[B, S, Ti]`` or a broadcastable ``[B, 1, Ti]``.
+    """
+    q0 = segments[0][0]
+    b, s, hq, d = q0.shape
+    hkv = segments[0][1].shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+
+    scored = []
+    for q, k_q, ks, v_q, vs, mask in segments:
+        qg = q.reshape(b, s, hkv, g, d)
+        sc = jnp.einsum(
+            "bskgd,bktd->bkgst", qg, k_q.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        sc = sc * (ks[:, :, None, None, :] * scale)
+        m = mask[:, None, None, :, :]  # [B, 1, 1, S, T]
+        scored.append((jnp.where(m, sc, _NEG_INF), m))
+
+    gmax = functools.reduce(
+        jnp.maximum,
+        [jnp.max(sc, axis=-1, keepdims=True) for sc, _ in scored],
+    )
+    denom = 0.0
+    out = 0.0
+    for (sc, m), (q, k_q, ks, v_q, vs, mask) in zip(scored, segments):
+        w = jnp.where(m, jnp.exp(sc - gmax), 0.0)
+        denom = denom + jnp.sum(w, axis=-1, keepdims=True)
+        wv = (w * vs[:, :, None, None, :]).astype(q0.dtype)
+        out = out + jnp.einsum(
+            "bkgst,bktd->bskgd", wv, v_q.astype(q0.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    denom = jnp.maximum(denom, 1e-20).transpose(0, 3, 1, 2, 4)
+    return (out / denom).reshape(b, s, hq, d).astype(q0.dtype)
+
+
 def gqa_attention_quantized_segments(
     q: jnp.ndarray,
     segments: Sequence[Tuple[jnp.ndarray, ...]],
@@ -225,41 +276,15 @@ def gqa_attention_quantized_segments(
     ``[B, Hkv, Ti, D]``, ``ks``/``vs`` f32 ``[B, Hkv, Ti]``, ``valid``
     ``[B, Ti]``. Scales apply to scores/probs (see
     :func:`gqa_attention_quantized`), so the int8 buffers feed the matmuls
-    directly.
+    directly. Delegates to the general shared-query-free form.
     """
-    b, s, hq, d = q.shape
-    hkv = segments[0][0].shape[1]
-    g = hq // hkv
-    if scale is None:
-        scale = d**-0.5
-    qg = q.reshape(b, s, hkv, g, d)
-
-    scored = []
-    for k_q, ks, v_q, vs, valid in segments:
-        sc = jnp.einsum(
-            "bskgd,bktd->bkgst", qg, k_q.astype(q.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        sc = sc * (ks[:, :, None, None, :] * scale)
-        m = valid[:, None, None, None, :]
-        scored.append((jnp.where(m, sc, _NEG_INF), m))
-
-    gmax = functools.reduce(
-        jnp.maximum,
-        [jnp.max(sc, axis=-1, keepdims=True) for sc, _ in scored],
+    return gqa_attention_quantized_multi_q_segments(
+        [
+            (q, k_q, ks, v_q, vs, valid[:, None, :])
+            for k_q, ks, v_q, vs, valid in segments
+        ],
+        scale,
     )
-    denom = 0.0
-    out = 0.0
-    for (sc, m), (k_q, ks, v_q, vs, valid) in zip(scored, segments):
-        w = jnp.where(m, jnp.exp(sc - gmax), 0.0)
-        denom = denom + jnp.sum(w, axis=-1, keepdims=True)
-        wv = (w * vs[:, :, None, None, :]).astype(q.dtype)
-        out = out + jnp.einsum(
-            "bkgst,bktd->bskgd", wv, v_q.astype(q.dtype),
-            preferred_element_type=jnp.float32,
-        )
-    denom = jnp.maximum(denom, 1e-20).transpose(0, 3, 1, 2, 4)
-    return (out / denom).reshape(b, s, hq, d).astype(q.dtype)
 
 
 def merge_softmax_segments(

@@ -50,7 +50,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import _NEG_INF
 
-__all__ = ["paged_attention", "quantized_paged_attention"]
+__all__ = [
+    "paged_attention",
+    "quantized_paged_attention",
+    "quantized_paged_fused_attention",
+]
 
 
 def _paged_kernel(
@@ -434,3 +438,401 @@ def quantized_paged_attention(
     if return_stats:
         return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)
     return out
+
+
+def quantized_paged_fused_attention(
+    q: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_ks: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    pool_vs: jnp.ndarray,
+    tail_k: jnp.ndarray,
+    tail_ks: jnp.ndarray,
+    tail_v: jnp.ndarray,
+    tail_vs: jnp.ndarray,
+    layer_idx: jnp.ndarray,
+    step_idx: jnp.ndarray,
+    page_table: jnp.ndarray,
+    base_len: jnp.ndarray,
+    tail_valid_len: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+    sliding_window: Optional[int] = None,
+):
+    """ONE kernel for a fused-decode step over the int8 page pool IN PLACE:
+    the WHOLE ``[L, P, Hkv, PS, D]`` pool passes through unsliced (the block
+    index map resolves ``(layer, physical page)``, so the operand is
+    zero-copy — the r2 per-layer pool slices materialized a full pool copy
+    per (layer, step), and the r3 gather-per-window fix held a second
+    contiguous copy of the live KV alive, halving the admissible batch at
+    long contexts); the step's fresh K/V quantizes in-kernel into the
+    io-aliased write-behind tail, which joins the page sweep as the final
+    online-softmax tile.
+
+    Shapes: ``q`` ``[B, 1, Hq, D]`` (rotated); ``k_new``/``v_new``
+    ``[B, 1, Hkv, D]`` (k rotated); pool planes ``[L, P, Hkv, PS, D]`` int8
+    (+ ``[L, P, Hkv, PS]`` f32 scales); tail planes ``[L, B, Hkv, KT, D]``
+    (+ scales, io-aliased). Returns ``(out, tail_k', tail_ks', tail_v',
+    tail_vs')``.
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode-only kernel (S=1), got S={s}")
+    num_l, _, hkv, page_size, _ = pool_k.shape
+    kt = tail_k.shape[3]
+    t = page_table.shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qr = q.reshape(b, hkv, g, d)
+    knr = jnp.moveaxis(k_new, 1, 2)  # [B, Hkv, 1, D]
+    vnr = jnp.moveaxis(v_new, 1, 2)
+    lref = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    sref = jnp.asarray(step_idx, jnp.int32).reshape(1)
+
+    def _pool_index(bi, ji, lidx, step, table, lens, vlen, qpos):
+        live = ji * page_size < lens[bi]
+        return (lidx[0], jnp.where(live, table[bi, ji], 0), 0, 0, 0)
+
+    def _pool_index4(bi, ji, lidx, step, table, lens, vlen, qpos):
+        live = ji * page_size < lens[bi]
+        return (lidx[0], jnp.where(live, table[bi, ji], 0), 0, 0)
+
+    def _tail_index(bi, ji, lidx, step, table, lens, vlen, qpos):
+        return (lidx[0], bi, 0, 0, 0)
+
+    def _tail_index3(bi, ji, lidx, step, table, lens, vlen, qpos):
+        return (lidx[0], bi, 0, 0)
+
+    def _row_index(bi, ji, lidx, step, table, lens, vlen, qpos):
+        return (bi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(b, t),
+        in_specs=[
+            pl.BlockSpec((1, hkv, g, d), _row_index),
+            pl.BlockSpec((1, hkv, 1, d), _row_index),
+            pl.BlockSpec((1, hkv, 1, d), _row_index),
+            pl.BlockSpec((1, 1, hkv, page_size, d), _pool_index),
+            pl.BlockSpec((1, 1, hkv, page_size), _pool_index4),
+            pl.BlockSpec((1, 1, hkv, page_size, d), _pool_index),
+            pl.BlockSpec((1, 1, hkv, page_size), _pool_index4),
+            pl.BlockSpec((1, 1, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, 1, hkv, kt), _tail_index3),
+            pl.BlockSpec((1, 1, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, 1, hkv, kt), _tail_index3),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, hkv, g, d), _row_index),
+            pl.BlockSpec((1, 1, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, 1, hkv, kt), _tail_index3),
+            pl.BlockSpec((1, 1, hkv, kt, d), _tail_index),
+            pl.BlockSpec((1, 1, hkv, kt), _tail_index3),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv * g, d), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+            pltpu.VMEM((hkv * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _qpaged_fused_kernel,
+        scale=scale,
+        page_size=page_size,
+        num_page_blocks=t,
+        sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
+        kt=kt,
+    )
+    out, tk, tks, tv, tvs = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct(tail_k.shape, tail_k.dtype),
+            jax.ShapeDtypeStruct(tail_ks.shape, tail_ks.dtype),
+            jax.ShapeDtypeStruct(tail_v.shape, tail_v.dtype),
+            jax.ShapeDtypeStruct(tail_vs.shape, tail_vs.dtype),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        # Tail planes update in place; indices count every flattened input
+        # including the 6 scalar-prefetch operands.
+        input_output_aliases={13: 1, 14: 2, 15: 3, 16: 4},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(lref, sref, page_table.astype(jnp.int32), base_len.astype(jnp.int32),
+      tail_valid_len.astype(jnp.int32), q_positions.astype(jnp.int32),
+      qr, knr, vnr,
+      pool_k, pool_ks, pool_v, pool_vs,
+      tail_k, tail_ks, tail_v, tail_vs)
+    return out.reshape(b, 1, hq, d), tk, tks, tv, tvs
+
+
+def _qpaged_fused_kernel(
+    lidx_ref,   # SMEM [1] int32 (layer; consumed by index maps)
+    step_ref,   # SMEM [1] int32 (tail write slot)
+    table_ref,  # SMEM [B, T] int32 (consumed by index maps)
+    len_ref,    # SMEM [B] int32 (live pool tokens)
+    vlen_ref,   # SMEM [B] int32 (valid tail slots incl. this write)
+    qpos_ref,   # SMEM [B] int32 (query positions)
+    q_ref,      # [1, Hkv, G, D]
+    kn_ref,     # [1, Hkv, 1, D]
+    vn_ref,     # [1, Hkv, 1, D]
+    k_ref,      # [1, 1, Hkv, PS, D] int8 (one physical page)
+    ks_ref,     # [1, 1, Hkv, PS] f32
+    v_ref,      # [1, 1, Hkv, PS, D] int8
+    vs_ref,     # [1, 1, Hkv, PS] f32
+    tk_ref,     # [1, 1, Hkv, KT, D] int8 (in)
+    tks_ref,    # [1, 1, Hkv, KT] f32 (in)
+    tv_ref,     # [1, 1, Hkv, KT, D] int8 (in)
+    tvs_ref,    # [1, 1, Hkv, KT] f32 (in)
+    out_ref,    # [1, Hkv, G, D]
+    tk_out,     # aliased tail outputs
+    tks_out,
+    tv_out,
+    tvs_out,
+    acc_ref,    # VMEM [Hkv*G, D] f32
+    m_ref,      # VMEM [Hkv*G, 128] f32
+    l_ref,      # VMEM [Hkv*G, 128] f32
+    *,
+    scale: float,
+    page_size: int,
+    num_page_blocks: int,
+    sliding_window: Optional[int],
+    hkv: int,
+    g: int,
+    kt: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                               # [Hkv, G, D]
+
+    def _accumulate(s, valid):
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        return p, alpha
+
+    def _tile(kk, kks, vv, vvs, valid, width):
+        s = jax.lax.dot_general(
+            q.astype(jnp.bfloat16).reshape(hkv, g, -1),
+            kk.astype(jnp.bfloat16).reshape(hkv, width, -1),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                        # [Hkv, G, W]
+        s = (s * kks[:, None, :] * scale).reshape(hkv * g, width)
+        p, alpha = _accumulate(s, valid)
+        pw = p.reshape(hkv, g, width) * vvs[:, None, :]
+        pv = jax.lax.dot_general(
+            pw.astype(jnp.bfloat16), vv.astype(jnp.bfloat16),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(hkv * g, -1)
+
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )
+    valid = pos < len_ref[b]
+    if sliding_window is not None:
+        valid &= pos > qpos_ref[b] - sliding_window
+    _tile(k_ref[0, 0], ks_ref[0, 0], v_ref[0, 0], vs_ref[0, 0], valid,
+          page_size)
+
+    @pl.when(j == num_page_blocks - 1)
+    def _tail_tile():
+        step = step_ref[0]
+        kn = kn_ref[0].astype(jnp.float32)     # [Hkv, 1, D]
+        vn = vn_ref[0].astype(jnp.float32)
+        ksc = jnp.maximum(jnp.max(jnp.abs(kn), axis=-1), 1e-8) / 127.0
+        vsc = jnp.maximum(jnp.max(jnp.abs(vn), axis=-1), 1e-8) / 127.0
+        kq = jnp.clip(jnp.round(kn / ksc[..., None]), -127, 127).astype(
+            jnp.int8
+        )
+        vq = jnp.clip(jnp.round(vn / vsc[..., None]), -127, 127).astype(
+            jnp.int8
+        )
+        slot = jax.lax.broadcasted_iota(jnp.int32, (1, kt, 1), 1)
+        hit3 = slot == step
+        hit2 = hit3[..., 0]
+        tk = jnp.where(hit3, kq, tk_ref[0, 0])    # [Hkv, KT, D]
+        tv = jnp.where(hit3, vq, tv_ref[0, 0])
+        tks = jnp.where(hit2, ksc, tks_ref[0, 0])  # [Hkv, KT]
+        tvs = jnp.where(hit2, vsc, tvs_ref[0, 0])
+        tk_out[0, 0] = tk
+        tv_out[0, 0] = tv
+        tks_out[0, 0] = tks
+        tvs_out[0, 0] = tvs
+
+        pos1 = jax.lax.broadcasted_iota(jnp.int32, (1, kt), 1)
+        tail_valid = pos1 < vlen_ref[b]
+        if sliding_window is not None:
+            tail_pos = len_ref[b] + pos1
+            tail_valid &= tail_pos > qpos_ref[b] - sliding_window
+        _tile(tk, tks, tv, tvs, tail_valid, kt)
+
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out_ref[0] = out.reshape(hkv, g, -1).astype(out_ref.dtype)
+
+
+def paged_tail_flush(
+    pool_k: jnp.ndarray,
+    pool_ks: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    pool_vs: jnp.ndarray,
+    tail_k: jnp.ndarray,
+    tail_ks: jnp.ndarray,
+    tail_v: jnp.ndarray,
+    tail_vs: jnp.ndarray,
+    page_table: jnp.ndarray,
+    base_len: jnp.ndarray,
+    tail_len: jnp.ndarray,
+    interpret: Optional[bool] = None,
+):
+    """Merge the fused window's int8 tail into the page pool by
+    read-modify-writing ONLY the pages each row's window touches.
+
+    Why a kernel: the XLA scatter (``cache/paged.py:_scatter_planes``)
+    prefers a transposed pool layout, so XLA inserts a whole-pool relayout
+    copy into the fused-decode executable feeding the Pallas attention's
+    default-layout operand — a 2x3.2 GB HLO temp at b24/1k-ctx 7B shapes
+    that OOMs the chip (and silently taxes smaller batches). Here each
+    (layer, row) round-trips at most ``ceil(KT/PS)+1`` physical pages
+    through VMEM with position-based composition (idempotent under clamped
+    duplicate visits), and the pool keeps its default layout end to end.
+
+    ``tail_*``: ``[L, B, Hkv, KT, D]`` int8 (+ ``[L, B, Hkv, KT]`` f32
+    scales), KT <= page_size. Rows must have table slots mapped through
+    ``base_len + tail_len`` (engine growth contract); clamped visits hit
+    the null page 0 and compose no changes. Returns the four updated pool
+    planes (inputs consumed — aliased).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    num_l, _, hkv, ps, d = pool_k.shape
+    b = page_table.shape[0]
+    t = page_table.shape[1]
+    kt = tail_k.shape[3]
+    if kt > ps:
+        raise ValueError(f"tail ({kt}) must fit one page ({ps})")
+    nj = -(-kt // ps) + 1  # straddle: at most 2 pages per row's window
+
+    def _pidx(li, bi, ji, table, lens, tl):
+        slot = jnp.minimum(lens[bi] // ps + ji, t - 1)
+        return (li, table[bi, slot], 0, 0, 0)
+
+    def _pidx4(li, bi, ji, table, lens, tl):
+        slot = jnp.minimum(lens[bi] // ps + ji, t - 1)
+        return (li, table[bi, slot], 0, 0)
+
+    def _tidx(li, bi, ji, table, lens, tl):
+        return (li, bi, 0, 0, 0)
+
+    def _tidx3(li, bi, ji, table, lens, tl):
+        return (li, bi, 0, 0)
+
+    def kernel(table_ref, lens_ref, tl_ref,
+               tk, tks, tv, tvs,
+               pk_in, pks_in, pv_in, pvs_in,
+               pk_out, pks_out, pv_out, pvs_out):
+        bi = pl.program_id(1)
+        ji = pl.program_id(2)
+        start = lens_ref[bi]
+        tl = tl_ref[bi]
+        slot = jnp.minimum(start // ps + ji, t - 1)
+
+        def compose_values(pool_ref, tail_ref, out_ref):
+            pos = slot * ps + jax.lax.broadcasted_iota(
+                jnp.int32, (1, ps, 1), 1
+            )
+            cur = pool_ref[0, 0]                       # [Hkv, PS, D]
+            tail = tail_ref[0, 0]                      # [Hkv, KT, D]
+            for i in range(kt):
+                hit = (pos == start + i) & (i < tl)
+                cur = jnp.where(hit, tail[:, i : i + 1], cur)
+            out_ref[0, 0] = cur
+
+        def compose_scales(pool_ref, tail_ref, out_ref):
+            pos = slot * ps + jax.lax.broadcasted_iota(
+                jnp.int32, (1, ps), 1
+            )
+            cur = pool_ref[0, 0]                       # [Hkv, PS]
+            tail = tail_ref[0, 0]                      # [Hkv, KT]
+            for i in range(kt):
+                hit = (pos == start + i) & (i < tl)
+                cur = jnp.where(hit, tail[:, i : i + 1], cur)
+            out_ref[0, 0] = cur
+
+        compose_values(pk_in, tk, pk_out)
+        compose_values(pv_in, tv, pv_out)
+        compose_scales(pks_in, tks, pks_out)
+        compose_scales(pvs_in, tvs, pvs_out)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(num_l, b, nj),
+        in_specs=[
+            pl.BlockSpec((1, 1, hkv, kt, d), _tidx),
+            pl.BlockSpec((1, 1, hkv, kt), _tidx3),
+            pl.BlockSpec((1, 1, hkv, kt, d), _tidx),
+            pl.BlockSpec((1, 1, hkv, kt), _tidx3),
+            pl.BlockSpec((1, 1, hkv, ps, d), _pidx),
+            pl.BlockSpec((1, 1, hkv, ps), _pidx4),
+            pl.BlockSpec((1, 1, hkv, ps, d), _pidx),
+            pl.BlockSpec((1, 1, hkv, ps), _pidx4),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, hkv, ps, d), _pidx),
+            pl.BlockSpec((1, 1, hkv, ps), _pidx4),
+            pl.BlockSpec((1, 1, hkv, ps, d), _pidx),
+            pl.BlockSpec((1, 1, hkv, ps), _pidx4),
+        ),
+        scratch_shapes=[],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(pool_k.shape, pool_k.dtype),
+            jax.ShapeDtypeStruct(pool_ks.shape, pool_ks.dtype),
+            jax.ShapeDtypeStruct(pool_v.shape, pool_v.dtype),
+            jax.ShapeDtypeStruct(pool_vs.shape, pool_vs.dtype),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        # Inputs counting scalars: table 0, lens 1, tl 2, tails 3-6,
+        # pools 7-10.
+        input_output_aliases={7: 0, 8: 1, 9: 2, 10: 3},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(page_table.astype(jnp.int32), base_len.astype(jnp.int32),
+      tail_len.astype(jnp.int32),
+      tail_k, tail_ks, tail_v, tail_vs,
+      pool_k, pool_ks, pool_v, pool_vs)

@@ -36,7 +36,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import _NEG_INF
 
-__all__ = ["quantized_decode_attention"]
+__all__ = [
+    "quantized_decode_attention",
+    "quantized_fused_decode_attention",
+    "fused_tail_flush",
+    "sink_fused_decode_attention",
+    "sink_tail_flush",
+]
 
 
 def _qdense_kernel(
@@ -683,5 +689,489 @@ def fused_tail_flush(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
     )(base_len.astype(jnp.int32), tail_len.astype(jnp.int32),
+      tail_k, tail_ks, tail_v, tail_vs,
+      big_k, big_ks, big_v, big_vs)
+
+
+def sink_fused_decode_attention(
+    q: jnp.ndarray,
+    q_sink: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    big_k: jnp.ndarray,
+    big_ks: jnp.ndarray,
+    big_v: jnp.ndarray,
+    big_vs: jnp.ndarray,
+    sink_k: jnp.ndarray,
+    sink_ks: jnp.ndarray,
+    sink_v: jnp.ndarray,
+    sink_vs: jnp.ndarray,
+    tail_k: jnp.ndarray,
+    tail_ks: jnp.ndarray,
+    tail_v: jnp.ndarray,
+    tail_vs: jnp.ndarray,
+    layer_idx: jnp.ndarray,
+    step_idx: jnp.ndarray,
+    ring_len: jnp.ndarray,
+    ring_ptr: jnp.ndarray,
+    evict_len: jnp.ndarray,
+    sink_len: jnp.ndarray,
+    tail_valid_len: jnp.ndarray,
+    ring_slots: int,
+    scale: Optional[float] = None,
+    block_t: int = 256,
+    block_b: int = 8,
+    interpret: Optional[bool] = None,
+):
+    """The fused decode step over the QUANTIZED SINK cache: one kernel per
+    (layer, step) sweeping three joint-softmax segments — the int8 ring of
+    recent tokens, the int8 attention sinks, and the write-behind tail the
+    step's fresh K/V is quantized into in place.
+
+    Position design (see ``cache/sink.py:QuantizedSinkKVCache``): RoPE
+    scores depend only on position DIFFERENCES, so ring keys are stored
+    rotated at their ABSOLUTE stream positions (write-once — the per-step
+    whole-window re-rotation of the bf16 ring, the reference's
+    ``cache.py:111-133`` re-rotation chain, disappears) and ``q`` is rotated
+    at the absolute query position. Only the handful of sink tokens need the
+    StreamingLLM compressed positions: they are stored rotated at their
+    fixed slots ``0..s-1`` and attended with ``q_sink``, the same query
+    rotated at its window-relative position.
+
+    Ring validity: live slots are the prefix ``[0, ring_len)``; of those,
+    the ``evict_len`` slots starting at ``ring_ptr`` (mod ``ring_slots``)
+    hold tokens the in-flight tail has already evicted (exact per-step
+    StreamingLLM window semantics, ahead of the physical overwrite at
+    flush). ``evict_len`` = this step's tail length; callers guarantee
+    the tail never exceeds the ring span (engine guard).
+
+    Shapes: ``q``/``q_sink`` ``[B, 1, Hq, D]``; ``k_new``/``v_new``
+    ``[B, 1, Hkv, D]`` (k abs-rotated); big stacks ``[L, B, Hkv, TR, D]``
+    (+ scales, TR = padded ring span); sink stacks ``[L, B, Hkv, SP, D]``
+    (+ scales); tail stacks ``[L, B, Hkv, KT, D]`` (+ scales, io-aliased).
+    Returns ``(out, tail_k', tail_ks', tail_v', tail_vs')``.
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode-only kernel (S=1), got S={s}")
+    num_l, _, hkv, t, _ = big_k.shape
+    kt = tail_k.shape[3]
+    sp = sink_k.shape[3]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Largest 32-multiple divisor of TR (caches pad TR to a 32 multiple) so
+    # tiles never straddle the buffer end.
+    bt = t
+    for cand in range(min(block_t, t), 31, -32):
+        if t % cand == 0:
+            bt = cand
+            break
+    num_blocks = t // bt
+    nb = next(n for n in range(min(block_b, b), 0, -1) if b % n == 0)
+    num_row_blocks = b // nb
+
+    qr = q.reshape(b, hkv, g, d)
+    qsr = q_sink.reshape(b, hkv, g, d)
+    knr = jnp.moveaxis(k_new, 1, 2)  # [B, Hkv, 1, D]
+    vnr = jnp.moveaxis(v_new, 1, 2)
+    lref = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    sref = jnp.asarray(step_idx, jnp.int32).reshape(1)
+
+    def _row_live(bi, ji, lens):
+        live = ji * bt < lens[bi * nb]
+        for r in range(1, nb):
+            live |= ji * bt < lens[bi * nb + r]
+        return live
+
+    def _big_index(bi, ji, lidx, step, lens, ptr, ev, slen, vlen):
+        return (lidx[0], bi, 0,
+                jnp.where(_row_live(bi, ji, lens), ji, 0), 0)
+
+    def _big_index3(bi, ji, lidx, step, lens, ptr, ev, slen, vlen):
+        return (lidx[0], bi, 0, jnp.where(_row_live(bi, ji, lens), ji, 0))
+
+    def _lay_index(bi, ji, lidx, step, lens, ptr, ev, slen, vlen):
+        return (lidx[0], bi, 0, 0, 0)
+
+    def _lay_index3(bi, ji, lidx, step, lens, ptr, ev, slen, vlen):
+        return (lidx[0], bi, 0, 0)
+
+    def _row_index(bi, ji, lidx, step, lens, ptr, ev, slen, vlen):
+        return (bi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(num_row_blocks, num_blocks),
+        in_specs=[
+            pl.BlockSpec((nb, hkv, g, d), _row_index),
+            pl.BlockSpec((nb, hkv, g, d), _row_index),
+            pl.BlockSpec((nb, hkv, 1, d), _row_index),
+            pl.BlockSpec((nb, hkv, 1, d), _row_index),
+            pl.BlockSpec((1, nb, hkv, bt, d), _big_index),
+            pl.BlockSpec((1, nb, hkv, bt), _big_index3),
+            pl.BlockSpec((1, nb, hkv, bt, d), _big_index),
+            pl.BlockSpec((1, nb, hkv, bt), _big_index3),
+            pl.BlockSpec((1, nb, hkv, sp, d), _lay_index),
+            pl.BlockSpec((1, nb, hkv, sp), _lay_index3),
+            pl.BlockSpec((1, nb, hkv, sp, d), _lay_index),
+            pl.BlockSpec((1, nb, hkv, sp), _lay_index3),
+            pl.BlockSpec((1, nb, hkv, kt, d), _lay_index),
+            pl.BlockSpec((1, nb, hkv, kt), _lay_index3),
+            pl.BlockSpec((1, nb, hkv, kt, d), _lay_index),
+            pl.BlockSpec((1, nb, hkv, kt), _lay_index3),
+        ],
+        out_specs=(
+            pl.BlockSpec((nb, hkv, g, d), _row_index),
+            pl.BlockSpec((1, nb, hkv, kt, d), _lay_index),
+            pl.BlockSpec((1, nb, hkv, kt), _lay_index3),
+            pl.BlockSpec((1, nb, hkv, kt, d), _lay_index),
+            pl.BlockSpec((1, nb, hkv, kt), _lay_index3),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((nb, hkv * g, d), jnp.float32),
+            pltpu.VMEM((nb, hkv * g, 128), jnp.float32),
+            pltpu.VMEM((nb, hkv * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _qsink_kernel,
+        scale=scale,
+        block_t=bt,
+        num_blocks=num_blocks,
+        ring_slots=ring_slots,
+        hkv=hkv,
+        g=g,
+        nb=nb,
+        sp=sp,
+        kt=kt,
+    )
+    out, tk, tks, tv, tvs = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct(tail_k.shape, tail_k.dtype),
+            jax.ShapeDtypeStruct(tail_ks.shape, tail_ks.dtype),
+            jax.ShapeDtypeStruct(tail_v.shape, tail_v.dtype),
+            jax.ShapeDtypeStruct(tail_vs.shape, tail_vs.dtype),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        # Tail stacks update in place; indices count every flattened input
+        # including the 7 scalar-prefetch operands.
+        input_output_aliases={19: 1, 20: 2, 21: 3, 22: 4},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(lref, sref, ring_len.astype(jnp.int32), ring_ptr.astype(jnp.int32),
+      evict_len.astype(jnp.int32), sink_len.astype(jnp.int32),
+      tail_valid_len.astype(jnp.int32),
+      qr, qsr, knr, vnr,
+      big_k, big_ks, big_v, big_vs,
+      sink_k, sink_ks, sink_v, sink_vs,
+      tail_k, tail_ks, tail_v, tail_vs)
+    return out.reshape(b, 1, hq, d), tk, tks, tv, tvs
+
+
+def _qsink_kernel(
+    lidx_ref,   # SMEM [1] int32 (layer; consumed by index maps)
+    step_ref,   # SMEM [1] int32 (tail write slot)
+    rlen_ref,   # SMEM [B] int32 (live ring prefix length)
+    rptr_ref,   # SMEM [B] int32 (ring write pointer = oldest live slot)
+    ev_ref,     # SMEM [B] int32 (slots evicted by the in-flight tail)
+    slen_ref,   # SMEM [B] int32 (valid sink slots)
+    vlen_ref,   # SMEM [B] int32 (valid tail slots incl. this write)
+    q_ref,      # [NB, Hkv, G, D] (abs-rotated)
+    qs_ref,     # [NB, Hkv, G, D] (window-relative-rotated, for sinks)
+    kn_ref,     # [NB, Hkv, 1, D]
+    vn_ref,     # [NB, Hkv, 1, D]
+    k_ref,      # [1, NB, Hkv, BT, D] int8 (ring)
+    ks_ref,     # [1, NB, Hkv, BT] f32
+    v_ref,      # [1, NB, Hkv, BT, D] int8
+    vs_ref,     # [1, NB, Hkv, BT] f32
+    sk_ref,     # [1, NB, Hkv, SP, D] int8 (sinks; read-only)
+    sks_ref,    # [1, NB, Hkv, SP] f32
+    sv_ref,     # [1, NB, Hkv, SP, D] int8
+    svs_ref,    # [1, NB, Hkv, SP] f32
+    tk_ref,     # [1, NB, Hkv, KT, D] int8 (in)
+    tks_ref,    # [1, NB, Hkv, KT] f32 (in)
+    tv_ref,     # [1, NB, Hkv, KT, D] int8 (in)
+    tvs_ref,    # [1, NB, Hkv, KT] f32 (in)
+    out_ref,    # [NB, Hkv, G, D]
+    tk_out,     # aliased tail outputs
+    tks_out,
+    tv_out,
+    tvs_out,
+    acc_ref,    # VMEM [NB, Hkv*G, D] f32
+    m_ref,      # VMEM [NB, Hkv*G, 128] f32
+    l_ref,      # VMEM [NB, Hkv*G, 128] f32
+    *,
+    scale: float,
+    block_t: int,
+    num_blocks: int,
+    ring_slots: int,
+    hkv: int,
+    g: int,
+    nb: int,
+    sp: int,
+    kt: int,
+):
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[:]                               # [NB, Hkv, G, D]
+
+    def _accumulate(s, valid):
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        return p, alpha
+
+    def _tile(qq, kk, kks, vv, vvs, valid, width):
+        """One online-softmax tile over ``width`` int8 slots."""
+        s = jax.lax.dot_general(
+            qq.astype(jnp.bfloat16).reshape(nb * hkv, g, -1),
+            kk.astype(jnp.bfloat16).reshape(nb * hkv, width, -1),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(nb, hkv, g, width)
+        s = (s * kks[:, :, None, :] * scale).reshape(nb, hkv * g, width)
+        p, alpha = _accumulate(s, valid)
+        pw = p.reshape(nb, hkv, g, width) * vvs[:, :, None, :]
+        pv = jax.lax.dot_general(
+            pw.astype(jnp.bfloat16).reshape(nb * hkv, g, width),
+            vv.astype(jnp.bfloat16).reshape(nb * hkv, width, -1),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(nb, hkv * g, -1)
+
+    def _ring_tile():
+        slot = j * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_t), 1
+        )
+        row_valids = []
+        for r in range(nb):
+            row = bi * nb + r
+            live = slot < rlen_ref[row]
+            # Slots in [ring_ptr, ring_ptr + evict_len) mod R hold tokens
+            # the in-flight tail has evicted (exact per-step window).
+            w = rptr_ref[row]
+            dd = slot - w + jnp.where(slot < w, ring_slots, 0)
+            row_valids.append(live & (dd >= ev_ref[row]))
+        valid = jnp.stack(row_valids)          # [NB, 1, BT]
+        _tile(q, k_ref[0], ks_ref[0], v_ref[0], vs_ref[0], valid, block_t)
+
+    _ring_tile()
+
+    @pl.when(j == num_blocks - 1)
+    def _final_tiles():
+        # -- sink tile (window-relative query) --------------------------------
+        slot1 = jax.lax.broadcasted_iota(jnp.int32, (1, sp), 1)
+        sink_valid = jnp.stack(
+            [slot1 < slen_ref[bi * nb + r] for r in range(nb)]
+        )
+        _tile(qs_ref[:], sk_ref[0], sks_ref[0], sv_ref[0], svs_ref[0],
+              sink_valid, sp)
+
+        # -- tail tile (quantize-in-kernel write + attend) --------------------
+        step = step_ref[0]
+        kn = kn_ref[:].astype(jnp.float32)     # [NB, Hkv, 1, D]
+        vn = vn_ref[:].astype(jnp.float32)
+        ksc = jnp.maximum(jnp.max(jnp.abs(kn), axis=-1), 1e-8) / 127.0
+        vsc = jnp.maximum(jnp.max(jnp.abs(vn), axis=-1), 1e-8) / 127.0
+        kq = jnp.clip(jnp.round(kn / ksc[..., None]), -127, 127).astype(
+            jnp.int8
+        )
+        vq = jnp.clip(jnp.round(vn / vsc[..., None]), -127, 127).astype(
+            jnp.int8
+        )
+        slot4 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, kt, 1), 2)
+        hit4 = slot4 == step
+        hit3 = hit4[..., 0]
+        tk = jnp.where(hit4, kq, tk_ref[0])    # [NB, Hkv, KT, D]
+        tv = jnp.where(hit4, vq, tv_ref[0])
+        tks = jnp.where(hit3, ksc, tks_ref[0])  # [NB, Hkv, KT]
+        tvs = jnp.where(hit3, vsc, tvs_ref[0])
+        tk_out[0] = tk
+        tv_out[0] = tv
+        tks_out[0] = tks
+        tvs_out[0] = tvs
+
+        pos1 = jax.lax.broadcasted_iota(jnp.int32, (1, kt), 1)
+        tail_valid = jnp.stack(
+            [pos1 < vlen_ref[bi * nb + r] for r in range(nb)]
+        )
+        _tile(q, tk, tks, tv, tvs, tail_valid, kt)
+
+        l = l_ref[:, :, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out_ref[:] = out.reshape(nb, hkv, g, -1).astype(out_ref.dtype)
+
+
+def sink_tail_flush(
+    big_k: jnp.ndarray,
+    big_ks: jnp.ndarray,
+    big_v: jnp.ndarray,
+    big_vs: jnp.ndarray,
+    tail_k: jnp.ndarray,
+    tail_ks: jnp.ndarray,
+    tail_v: jnp.ndarray,
+    tail_vs: jnp.ndarray,
+    ring_ptr: jnp.ndarray,
+    skip: jnp.ndarray,
+    tail_len: jnp.ndarray,
+    ring_slots: int,
+    interpret: Optional[bool] = None,
+):
+    """:func:`fused_tail_flush` for the sink RING: merge the write-behind
+    tail into the int8 ring planes at per-row slots that WRAP mod
+    ``ring_slots``. Tail token ``i`` (for ``skip <= i < tail_len``) lands at
+    ring slot ``(ring_ptr + i - skip) % ring_slots``; the first ``skip``
+    tokens are sink-bound (stream positions below the sink span) and are
+    merged into the small sink planes by the caller in XLA.
+
+    Blocked RMW like the dense flush, with a third block visit pinned to
+    block 0 so a wrapped window's head is always covered (a consecutive
+    mod-``nbv`` sweep can miss it when the ring spans >2 blocks).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    num_l, b, hkv, t, d = big_k.shape
+    kt = tail_k.shape[3]
+    BV = 32
+    BS = 128
+    nbv = t // BV
+    nbs = -(-t // BS)
+    nj = 3  # {ptr block, next mod, block 0} — covers straddle AND wrap
+
+    def _vidx(li, bi, ji, ptr, sk, tl):
+        blk = jnp.where(
+            ji == nj - 1, 0, (ptr[bi] // BV + ji) % nbv
+        )
+        return (li, bi, 0, blk, 0)
+
+    def _sidx(li, bi, ji, ptr, sk, tl):
+        blk = jnp.where(
+            ji == nj - 1, 0, (ptr[bi] // BS + ji) % nbs
+        )
+        return (li, bi, 0, blk)
+
+    def _tidx(li, bi, ji, ptr, sk, tl):
+        return (li, bi, 0, 0, 0)
+
+    def _tidx3(li, bi, ji, ptr, sk, tl):
+        return (li, bi, 0, 0)
+
+    def kernel(ptr_ref, skip_ref, tl_ref,
+               tk, tks, tv, tvs,
+               bk_in, bks_in, bv_in, bvs_in,
+               bk_out, bks_out, bv_out, bvs_out):
+        bi = pl.program_id(1)
+        ji = pl.program_id(2)
+        ptr = ptr_ref[bi]
+        sk_n = skip_ref[bi]
+        tl = tl_ref[bi]
+
+        def targets():
+            """Ring slot of each tail index (mod ring_slots) + liveness."""
+            out = []
+            for i in range(kt):
+                t0 = ptr + (i - sk_n)
+                tgt = jax.lax.rem(
+                    jnp.maximum(t0, 0), jnp.int32(ring_slots)
+                )
+                out.append((tgt, (i >= sk_n) & (i < tl)))
+            return out
+
+        tgts = targets()
+
+        def compose_values(big_ref, tail_ref, out_ref, blk):
+            pos = blk * BV + jax.lax.broadcasted_iota(
+                jnp.int32, (1, BV, 1), 1
+            )
+            cur = big_ref[0, 0]                        # [Hkv, BV, D]
+            tail = tail_ref[0, 0]                      # [Hkv, KT, D]
+            for i in range(kt):
+                tgt, live = tgts[i]
+                hit = (pos == tgt) & live
+                cur = jnp.where(hit, tail[:, i : i + 1], cur)
+            out_ref[0, 0] = cur
+
+        def compose_scales(big_ref, tail_ref, out_ref, blk):
+            pos = blk * BS + jax.lax.broadcasted_iota(
+                jnp.int32, (1, BS), 1
+            )
+            cur = big_ref[0, 0]                        # [Hkv, BS]
+            tail = tail_ref[0, 0]                      # [Hkv, KT]
+            for i in range(kt):
+                tgt, live = tgts[i]
+                hit = (pos == tgt) & live
+                cur = jnp.where(hit, tail[:, i : i + 1], cur)
+            out_ref[0, 0] = cur
+
+        vblk = jnp.where(ji == nj - 1, 0, (ptr // BV + ji) % nbv)
+        sblk = jnp.where(ji == nj - 1, 0, (ptr // BS + ji) % nbs)
+        compose_values(bk_in, tk, bk_out, vblk)
+        compose_values(bv_in, tv, bv_out, vblk)
+        compose_scales(bks_in, tks, bks_out, sblk)
+        compose_scales(bvs_in, tvs, bvs_out, sblk)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(num_l, b, nj),
+        in_specs=[
+            pl.BlockSpec((1, 1, hkv, kt, d), _tidx),
+            pl.BlockSpec((1, 1, hkv, kt), _tidx3),
+            pl.BlockSpec((1, 1, hkv, kt, d), _tidx),
+            pl.BlockSpec((1, 1, hkv, kt), _tidx3),
+            pl.BlockSpec((1, 1, hkv, BV, d), _vidx),
+            pl.BlockSpec((1, 1, hkv, BS), _sidx),
+            pl.BlockSpec((1, 1, hkv, BV, d), _vidx),
+            pl.BlockSpec((1, 1, hkv, BS), _sidx),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, hkv, BV, d), _vidx),
+            pl.BlockSpec((1, 1, hkv, BS), _sidx),
+            pl.BlockSpec((1, 1, hkv, BV, d), _vidx),
+            pl.BlockSpec((1, 1, hkv, BS), _sidx),
+        ),
+        scratch_shapes=[],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(big_k.shape, big_k.dtype),
+            jax.ShapeDtypeStruct(big_ks.shape, big_ks.dtype),
+            jax.ShapeDtypeStruct(big_v.shape, big_v.dtype),
+            jax.ShapeDtypeStruct(big_vs.shape, big_vs.dtype),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        # Inputs counting scalars: ptr 0, skip 1, tl 2, tails 3-6, bigs 7-10.
+        input_output_aliases={7: 0, 8: 1, 9: 2, 10: 3},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(ring_ptr.astype(jnp.int32), skip.astype(jnp.int32),
+      tail_len.astype(jnp.int32),
       tail_k, tail_ks, tail_v, tail_vs,
       big_k, big_ks, big_v, big_vs)

@@ -1,0 +1,198 @@
+"""Quantized (serving-grade) sink cache vs the bf16 ring oracle.
+
+``QuantizedSinkKVCache`` re-derives the StreamingLLM window
+(``/root/reference/distributed_llm_inference/models/llama/cache.py:7-135``)
+as int8 planes with absolute-position key rotation (scores depend only on
+position DIFFERENCES) plus a window-relative second query for the sink
+segment, so it must match the bf16 ``SinkKVCache`` — whose own correctness
+is pinned against a from-scratch oracle in ``test_sink_cache.py`` — up to
+int8 quantization noise, through eviction wrap-arounds, on every path:
+chunked prefill, per-step decode, the fused write-behind tail (XLA and
+Pallas-kernel variants), and the serving engine end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.cache.sink import (
+    QuantizedSinkKVCache,
+    SinkKVCache,
+)
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+
+HKV, HQ, D = 2, 4, 8
+CFG = ModelConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=96, num_layers=2,
+    num_heads=HQ, num_kv_heads=HKV, head_dim=D,
+)
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(1), dtype=jnp.float32)
+
+
+def _cos(a, b):
+    a = np.asarray(a, np.float32).ravel()
+    b = np.asarray(b, np.float32).ravel()
+    return float((a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+
+def test_quantized_sink_matches_bf16_ring_through_wraparound():
+    """Prefill + long decode past several wraps: logits track the bf16 ring
+    (whose semantics are oracle-pinned) within int8 noise, per row, with
+    per-row divergent stream lengths."""
+    params = _params()
+    W, S = 16, 2
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 0, 64)
+
+    bf = SinkKVCache.create(2, 2, W, S, HKV, D, dtype=jnp.float32)
+    qc = QuantizedSinkKVCache.create(2, 2, W, S, HKV, D)
+
+    nn = jnp.asarray([10, 7], jnp.int32)
+    lb, bf = llama.model_apply(CFG, params, tokens, bf, nn)
+    lq, qc = llama.model_apply(CFG, params, tokens, qc, nn)
+    assert _cos(lq[0, 9], lb[0, 9]) > 0.999
+    assert _cos(lq[1, 6], lb[1, 6]) > 0.999
+
+    tok = jnp.asarray([[1], [2]])
+    one = jnp.ones((2,), jnp.int32)
+    worst = 1.0
+    for _ in range(3 * W):
+        lb, bf = llama.model_apply(CFG, params, tok, bf, one)
+        lq, qc = llama.model_apply(CFG, params, tok, qc, one)
+        for r in range(2):
+            worst = min(worst, _cos(lq[r, 0], lb[r, 0]))
+    assert worst > 0.999, worst
+    assert qc.lengths.tolist() == bf.seen.tolist()
+
+
+@pytest.mark.parametrize("use_kernel", [False, True])
+def test_quantized_sink_fused_tail_matches_per_step(use_kernel):
+    """The fused write-behind tail (masked pre-eviction + mod-ring flush)
+    produces the SAME tokens as per-step attend decode, across a wrap, on
+    both the XLA and Pallas (interpret off-TPU) variants."""
+    params = _params()
+    W, S, K = 40, 2, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 30), 0, 64)
+    nn = jnp.full((2,), 30, jnp.int32)
+
+    def mk(uk):
+        qc = QuantizedSinkKVCache.create(2, 2, W, S, HKV, D, use_kernel=uk)
+        _, qc = llama.model_apply(CFG, params, tokens, qc, nn)
+        return qc
+
+    ref = mk(False)
+    t = jnp.asarray([[3], [5]])
+    one = jnp.ones((2,), jnp.int32)
+    ref_toks = []
+    for _ in range(2 * K):
+        lg, ref = llama.model_apply(CFG, params, t, ref, one)
+        nxt = jnp.argmax(lg[:, 0], -1).astype(jnp.int32)
+        ref_toks.append(np.asarray(nxt))
+        t = nxt[:, None]
+    ref_toks = np.stack(ref_toks)
+
+    def step_fn(i, logits, state):
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        return nxt, jnp.ones((2,), jnp.int32), state, nxt
+
+    qc = mk(use_kernel)
+    t = jnp.asarray([[3], [5]])
+    outs = []
+    for _ in range(2):
+        emits, qc = llama.multi_decode_apply(
+            CFG, params, t, qc, K, step_fn, None, jnp.ones((2,), jnp.int32)
+        )
+        outs.append(np.asarray(emits))
+        t = jnp.asarray(outs[-1][-1])[:, None]
+    got = np.concatenate(outs)
+    np.testing.assert_array_equal(got, ref_toks)
+    assert qc.lengths.tolist() == [46, 46]
+
+
+def test_quantized_sink_tail_sink_phase_and_partial_rows():
+    """1-token prompt (the flush must route early tokens into the SINK
+    planes, not the ring) + a row that stops mid-window (partial tail)."""
+    params = _params()
+    W, S, K = 24, 4, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 2), 0, 64)
+    nn = jnp.asarray([2, 1], jnp.int32)
+
+    def step_fn(i, logits, state):
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        alive = state & (i < 3) | (state & jnp.asarray([True, False]))
+        return nxt, alive.astype(jnp.int32), alive, nxt
+
+    def run(use_tail, uk):
+        qc = QuantizedSinkKVCache.create(2, 2, W, S, HKV, D, use_kernel=uk)
+        _, qc = llama.model_apply(CFG, params, tokens, qc, nn)
+        t = jnp.asarray([[3], [5]])
+        alive = jnp.asarray([True, True])
+        toks = []
+        for _ in range(5):  # deep wrap for row 0
+            if use_tail:
+                emits, qc = llama.multi_decode_apply(
+                    CFG, params, t, qc, K, step_fn, alive,
+                    alive.astype(jnp.int32),
+                )
+                e = np.asarray(emits)
+                toks.append(e)
+                t = jnp.asarray(e[-1])[:, None]
+                for i in range(K):
+                    alive = alive & (i < 3) | (
+                        alive & jnp.asarray([True, False])
+                    )
+            else:
+                for i in range(K):
+                    lg, qc = llama.model_apply(
+                        CFG, params, t, qc, alive.astype(jnp.int32)
+                    )
+                    nxt = jnp.argmax(lg[:, 0], -1).astype(jnp.int32)
+                    toks.append(np.asarray(nxt)[None])
+                    t = nxt[:, None]
+                    alive = alive & (i < 3) | (
+                        alive & jnp.asarray([True, False])
+                    )
+        return np.concatenate(toks), np.asarray(qc.lengths)
+
+    ref, rl = run(False, False)
+    for uk in (False, True):
+        got, gl = run(True, uk)
+        np.testing.assert_array_equal(rl, gl)
+        np.testing.assert_array_equal(got[:, 0], ref[:, 0])
+
+
+def test_engine_quantized_sink_kernel_matches_xla():
+    """Serving engine over kind="sink" kv_quant="int8": the Pallas fused
+    path and the XLA segments path emit identical tokens; the bf16 sink
+    engine agrees on stream lengths (unbounded serving works)."""
+    params = _params()
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7],
+               list(range(11, 27))]
+    opts = SamplingOptions(max_new_tokens=40, temperature=0.0)
+
+    def run(kv_quant, use_pallas):
+        eng = InferenceEngine(
+            CFG, params,
+            EngineConfig(max_batch_size=2, max_seq_len=128, dtype="float32",
+                         use_pallas_attention=use_pallas),
+            CacheConfig(kind="sink", window_length=24, num_sink_tokens=2,
+                        kv_quant=kv_quant),
+        )
+        return eng.generate(prompts, opts)
+
+    q_xla = run("int8", False)
+    q_krn = run("int8", True)
+    assert q_xla == q_krn
+    assert [len(g) for g in q_xla] == [40, 40, 40]
+    bf = run(None, False)
+    assert [len(g) for g in bf] == [40, 40, 40]
