@@ -29,14 +29,13 @@ contract, as with ``DenseKVCache.fits``).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..ops.attention import _NEG_INF, causal_mask
+from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope, rope_cos_sin
 from .base import GatherAttendMixin
 from .dense import _DenseRowsMixin, _quantize_kv

@@ -30,6 +30,28 @@ import time
 from typing import List, Optional, Tuple
 
 
+def _model_source(args):
+    """``(resolve, cache_note)`` for ``--model``: a local snapshot dir uses
+    direct path lookup; an ``http(s)://`` URL streams files on demand into
+    a local content cache (``utils/hub.py`` — the reference's
+    ``cached_file`` hub route, ``utils/model.py:27-34``), so a node
+    cold-starts on a fresh host with nothing pre-populated on disk."""
+    model = args.model
+    if model.startswith(("http://", "https://")):
+        import hashlib
+        import os
+
+        from .utils.hub import HttpResolver
+
+        root = getattr(args, "weights_cache", None) or os.path.expanduser(
+            "~/.cache/distribute"
+        )
+        slug = hashlib.sha1(model.encode()).hexdigest()[:12]
+        cache = os.path.join(root, f"remote-{slug}")
+        return HttpResolver(model, cache), cache
+    return None, None
+
+
 def _parse_relay(addr: str) -> Tuple[str, int]:
     host, _, port = addr.rpartition(":")
     if not port.isdigit():
@@ -98,10 +120,11 @@ def cmd_serve(args) -> int:
 
     host, port = _parse_relay(args.relay)
     first, last = _parse_layers(args.layers)
-    cfg = checkpoint.load_config(args.model)
+    resolve, _ = _model_source(args)
+    cfg = checkpoint.load_config(args.model, resolve=resolve)
     params = checkpoint.load_block_params(
         args.model, cfg, list(range(first, last + 1)),
-        jnp.dtype(args.dtype), cache_dir=args.weights_cache,
+        jnp.dtype(args.dtype), resolve=resolve, cache_dir=args.weights_cache,
     )
     node = ServingNode(
         port, cfg, params["layers"], first, last, host=host,
@@ -132,8 +155,11 @@ def cmd_generate(args) -> int:
 
     host, port = _parse_relay(args.relay)
     prompt, tok = _resolve_prompt(args)
-    cfg = checkpoint.load_config(args.model)
-    params = checkpoint.load_client_params(args.model, cfg, jnp.dtype(args.dtype))
+    resolve, _ = _model_source(args)
+    cfg = checkpoint.load_config(args.model, resolve=resolve)
+    params = checkpoint.load_client_params(
+        args.model, cfg, jnp.dtype(args.dtype), resolve=resolve
+    )
     with DistributedClient(
         port, cfg, params, host=host, dtype=jnp.dtype(args.dtype)
     ) as client:
@@ -168,9 +194,11 @@ def cmd_local(args) -> int:
     if args.speculative_draft and args.temperature:
         raise SystemExit("--speculative-draft is greedy-only "
                          "(remove --temperature)")
-    cfg = checkpoint.load_config(args.model)
+    resolve, _ = _model_source(args)
+    cfg = checkpoint.load_config(args.model, resolve=resolve)
     params = checkpoint.load_model_params(
-        args.model, cfg, jnp.dtype(args.dtype), cache_dir=args.weights_cache
+        args.model, cfg, jnp.dtype(args.dtype), resolve=resolve,
+        cache_dir=args.weights_cache,
     )
     from .utils.tracing import profile_trace
 
@@ -234,13 +262,14 @@ def cmd_info(args) -> int:
     from .models import registry
     from .utils import checkpoint
 
-    cfg = checkpoint.load_config(args.model, validate=False)
+    resolve, _ = _model_source(args)
+    cfg = checkpoint.load_config(args.model, validate=False, resolve=resolve)
     try:
         registry.validate_config(cfg)
         supported = True
     except (KeyError, ValueError):
         supported = False
-    resolve = checkpoint._default_resolve(args.model)
+    resolve = resolve or checkpoint._default_resolve(args.model)
     entry = checkpoint.find_index(resolve)
     print(json.dumps({
         "model": args.model, "entry": entry, "family": cfg.family,

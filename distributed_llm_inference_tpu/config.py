@@ -213,3 +213,12 @@ class EngineConfig:
     pipelined_ticks: bool = True
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled
+    # Propose→verify→accept ROUNDS fused into one device dispatch (draft
+    # scan, k+1-position verify, acceptance, cache rollback and draft
+    # catch-up all in-graph, lax.scan over rounds). Each synchronous
+    # speculative tick otherwise pays 2+ tunnel round trips (~35 ms each) —
+    # more than the whole round's device time at the latency-bound small
+    # batches speculation exists for. None = auto: decode_steps' token
+    # budget divided by k+1 proposals per round (>=1); 1 recovers
+    # per-round dispatch.
+    speculative_rounds: Optional[int] = None

@@ -563,6 +563,157 @@ class InferenceEngine:
             vdk = dict(donate_argnums=(4,)) if donate else {}
             self._verify = self._with_mesh(jax.jit(_verify, **vdk))
 
+            # -- fused multi-round speculation --------------------------------
+            # R propose→verify→accept rounds in ONE dispatch: acceptance,
+            # EOS/budget stops, target-cache rollback (a per-row lengths
+            # decrement — validity derives from lengths) and draft catch-up
+            # all carried on device. The synchronous tick pays 2+ tunnel
+            # round trips per round (~35 ms each at 7B shapes), which at the
+            # latency-bound small batches speculation serves is several
+            # times the round's device time. Output is bit-identical to
+            # plain greedy decoding (same argmax decisions, same prefixes).
+            self.spec_rounds = (
+                self.ecfg.speculative_rounds
+                if self.ecfg.speculative_rounds is not None
+                else max(1, (self.ecfg.decode_steps or 16) // (sk + 1))
+            )
+            R = self.spec_rounds
+
+            def _spec_round_fn(params_, dparams_, tokens, cache, dcache,
+                               spec, active, eos_ids, budget, key, sp):
+                """``R`` fused speculative rounds. Returns
+                ``(pack [R, B, k+3] int32, tok_carry [B, 1], cache,
+                dcache)`` — pack = emits (k+1 slots, -1 padded) ++ acc ++
+                palive per round, ONE array so the host pays ONE fetch
+                (a device_get on this platform's tunnel costs ~180 ms
+                regardless of size; three of them per tick was most of the
+                r3 speculative path's 6x loss)."""
+                b_ = tokens.shape[0]
+                jidx = jnp.arange(sk + 1, dtype=jnp.int32)[None, :]
+
+                def one_round(carry, i):
+                    tok, cache, dcache, alive, used = carry
+                    palive = (alive & spec).astype(jnp.int32)
+
+                    def dstep(c2, _):
+                        t2, dc = c2
+                        lgd, dc = llama.model_apply(
+                            dcfg, dparams_, t2, dc, palive
+                        )
+                        nxt = jnp.argmax(lgd[:, 0], -1).astype(jnp.int32)
+                        return (nxt[:, None], dc), nxt
+
+                    (_, dcache), prop = jax.lax.scan(
+                        dstep, (tok, dcache), None, length=sk
+                    )
+                    prop_t = prop.T  # [B, k]
+                    seq = jnp.concatenate(
+                        [tok, jnp.where(spec[:, None], prop_t, 0)], axis=1
+                    )
+                    num_new = jnp.where(
+                        alive, jnp.where(spec, sk + 1, 1), 0
+                    ).astype(jnp.int32)
+                    lg, cache = llama.model_apply(
+                        cfg, params_, seq, cache, num_new, **batch_mkw
+                    )
+                    preds = jnp.argmax(lg, -1).astype(jnp.int32)  # [B, k+1]
+                    sampled = sample(
+                        lg[:, 0], jax.random.fold_in(key, i), sp
+                    )
+
+                    agree = prop_t == preds[:, :sk]
+                    acc = jnp.sum(
+                        jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1
+                    )  # [B] longest agreeing prefix
+                    pred_at_acc = jnp.take_along_axis(
+                        preds, acc[:, None], axis=1
+                    )
+                    prop_ext = jnp.pad(prop_t, ((0, 0), (0, 1)))
+                    cand = jnp.where(
+                        jidx < acc[:, None], prop_ext, pred_at_acc
+                    )
+                    plain = jnp.concatenate(
+                        [sampled[:, None],
+                         jnp.zeros((b_, sk), jnp.int32)], axis=1
+                    )
+                    cand = jnp.where(spec[:, None], cand, plain)
+
+                    count = jnp.where(spec, acc + 1, 1) * alive
+                    # EOS: truncate at the first emitted EOS; budget:
+                    # truncate at the row's remaining token allowance.
+                    iseos = cand == eos_ids[:, None]
+                    first_eos = jnp.min(
+                        jnp.where(iseos, jidx, sk + 2), axis=1
+                    )
+                    count = jnp.minimum(count, first_eos + 1)
+                    rem = jnp.maximum(budget - used, 0)
+                    count = jnp.minimum(count, rem)
+                    hit_eos = first_eos < count
+                    alive = alive & ~hit_eos & (used + count < budget)
+
+                    # Rollback: the verify wrote num_new positions; the
+                    # accepted sequence state is base + count for target
+                    # AND draft (both then hold kv for [..., tok,
+                    # emitted[0..count-2]]; the next round consumes
+                    # emitted[count-1]).
+                    cache = cache.replace(
+                        lengths=cache.lengths - (num_new - count)
+                    )
+                    d_roll = palive * jnp.maximum(sk - count, 0)
+                    dcache = dcache.replace(
+                        lengths=dcache.lengths - d_roll
+                    )
+                    # Full acceptance: the draft never consumed its own
+                    # final proposal — one masked catch-up forward.
+                    catch = (palive == 1) & (count == sk + 1)
+                    catch_tok = jnp.take_along_axis(
+                        cand, jnp.maximum(count - 2, 0)[:, None], axis=1
+                    )
+                    _, dcache = llama.model_apply(
+                        dcfg, dparams_, catch_tok, dcache,
+                        catch.astype(jnp.int32),
+                    )
+
+                    emit = jnp.where(jidx < count[:, None], cand, -1)
+                    last = jnp.take_along_axis(
+                        cand, jnp.maximum(count - 1, 0)[:, None], axis=1
+                    )
+                    tok = jnp.where(count[:, None] > 0, last, tok)
+                    return (
+                        (tok, cache, dcache, alive, used + count),
+                        (emit, acc, palive),
+                    )
+
+                zero = jnp.zeros((b_,), jnp.int32)
+                # UNROLLED rounds: under lax.scan XLA re-stages the loop
+                # bodies' small invariant operands (head scales, norms, rope
+                # tables) every iteration. R is small.
+                carry = (tokens, cache, dcache, active, zero)
+                outs = []
+                for i in range(R):
+                    carry, out = one_round(carry, i)
+                    outs.append(out)
+                (tok, cache, dcache, _, _) = carry
+                pack = jnp.stack([
+                    jnp.concatenate(
+                        [emit, acc[:, None], palive[:, None]], axis=1
+                    )
+                    for emit, acc, palive in outs
+                ])  # [R, B, k+3]
+                return pack, tok, cache, dcache
+
+            sdk = dict(donate_argnums=(3, 4)) if donate else {}
+            self._spec_rounds_fn = self._with_mesh(
+                jax.jit(_spec_round_fn, **sdk)
+            )
+            # Pipelined speculation state: the in-flight tick's packed
+            # result + bookkeeping, and the device-resident token carry
+            # (tick N dispatches from tick N-1's final tokens WITHOUT
+            # fetching them — the fetch overlaps tick N's compute).
+            self._spec_pending = None
+            self._spec_carry = None
+            self._spec_carry_ok = np.zeros(self.batch, np.bool_)
+
     def _sink_cap(self) -> int:
         """Stream-length bound for sink sessions. The bf16 ring rotates at
         window-relative (bounded) positions, so its streams are limited only
@@ -754,6 +905,7 @@ class InferenceEngine:
                 bool(self.waiting)
                 or any(s is not None for s in self.slots)
                 or self._pending is not None
+                or getattr(self, "_spec_pending", None) is not None
             )
 
     def generate(
@@ -1170,7 +1322,12 @@ class InferenceEngine:
             g is not None and self._session_speculative(self.sessions[g])
             for g in self.slots
         ):
+            if self.ecfg.pipelined_ticks:
+                return self._speculative_rounds_tick(produced)
             return self._speculative_tick(produced)
+        if self.draft is not None and self._spec_pending is not None:
+            # Last speculative session retired with a tick in flight.
+            self._spec_flush(produced)
         K = max(1, self.decode_steps)
         tokens = np.zeros((self.batch, 1), np.int32)
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
@@ -1297,6 +1454,177 @@ class InferenceEngine:
             self._finish(s, "capacity", produced)
             return None
         return cap
+
+    def _spec_rounds_capacity_ok(self, produced, pend_b=None) -> bool:
+        """The fused multi-round dispatch cannot grow pages or finish
+        sessions mid-scan, so every resident session must have physical
+        room for the worst case (``R * (k+1)`` positions per dispatch —
+        each round's verify writes k+1 before the in-graph rollback trims
+        it — PLUS the in-flight tick's worst case when pipelined). Grows
+        pages/buffers up front; returns False (→ the synchronous
+        per-round tick, which handles per-round growth and capacity
+        degradation) when any row falls short."""
+        worst = self.spec_rounds * (self.ecfg.speculative_k + 1)
+        for slot, gid in enumerate(self.slots):
+            if gid is None:
+                continue
+            s = self.sessions[gid]
+            need = s.total_len + worst + (
+                int(pend_b[slot]) if pend_b is not None else 0
+            )
+            if isinstance(self.cache, PagedKVCache):
+                if self._grow_pages(s, need - s.total_len) < need:
+                    return False
+            else:
+                if need > self.ecfg.max_seq_len:
+                    return False
+        if self._windows and not isinstance(self.cache, PagedKVCache):
+            live = [self.sessions[g] for g in self.slots if g is not None]
+            if live:
+                self._ensure_capacity(
+                    max(s.total_len for s in live) + worst + (
+                        int(pend_b.max()) if pend_b is not None else 0
+                    )
+                )
+        return True
+
+    def _speculative_rounds_tick(self, produced) -> None:
+        """Fused, PIPELINED speculation: each ``step()`` dispatches
+        ``spec_rounds`` propose→verify→accept rounds in ONE device call
+        (see ``_spec_round_fn``), from a device-resident token carry, and
+        THEN resolves the previous tick's packed result — so the ~180 ms
+        tunnel fetch overlaps the new tick's compute. Token streams are
+        identical to the synchronous ``_speculative_tick`` (same greedy
+        acceptance rule); events arrive one ``step()`` later."""
+        prev = self._spec_pending
+        if not self._spec_rounds_capacity_ok(produced, self._spec_pend(prev)):
+            # Drain the pipeline FIRST (exactly once), then degrade to the
+            # synchronous per-round tick, which handles per-round growth
+            # and capacity session finishes.
+            self._spec_flush(produced)
+            return self._speculative_tick(produced)
+        self._spec_pending = self._spec_dispatch(produced, prev)
+        self._spec_resolve(produced, prev)
+
+    def _spec_pend(self, prev):
+        """Conservative in-flight token charge per slot (0 where the slot's
+        tenant changed since dispatch)."""
+        if prev is None:
+            return np.zeros((self.batch,), np.int32)
+        return np.where(
+            np.array([g == pg for g, pg in zip(self.slots, prev[4])]),
+            prev[3], 0,
+        )
+
+    def _spec_flush(self, produced) -> None:
+        """Resolve any in-flight speculative tick (pipeline drain — used
+        before falling back to the synchronous path)."""
+        prev = self._spec_pending
+        self._spec_pending = None
+        self._spec_resolve(produced, prev)
+
+    def _spec_dispatch(self, produced, prev):
+        """Enqueue one fused multi-round speculative tick; returns the
+        pending tuple (or None). Budgets are conservative against the
+        in-flight tick (``prev``), mirroring ``_dispatch_tick``."""
+        k = self.ecfg.speculative_k
+        R = self.spec_rounds
+        b = self.batch
+        pend_b = self._spec_pend(prev)
+        fresh = np.zeros((b, 1), np.int32)
+        use_carry = np.zeros((b,), np.bool_)
+        opts: List[SamplingOptions] = [SamplingOptions()] * b
+        spec = np.zeros((b,), np.bool_)
+        budget = np.zeros((b,), np.int32)
+        for slot, gid in enumerate(self.slots):
+            if gid is None:
+                continue
+            s = self.sessions[gid]
+            fresh[slot, 0] = s.last_token
+            use_carry[slot] = self._spec_carry_ok[slot]
+            opts[slot] = s.options
+            spec[slot] = self._session_speculative(s)
+            budget[slot] = max(
+                0,
+                s.options.max_new_tokens - len(s.generated)
+                - int(pend_b[slot]),
+            )
+        active = np.array(
+            [g is not None for g in self.slots], np.bool_
+        ) & (budget > 0)
+        if not active.any():
+            return None
+        sp = SamplingParams.stack(opts)
+        eos_ids = np.asarray([o.eos_token_id for o in opts], np.int32)
+        if self._spec_carry is None:
+            tokens_dev = jnp.asarray(fresh)
+        else:
+            tokens_dev = self._carry_combine(
+                jnp.asarray(fresh), self._spec_carry,
+                jnp.asarray(use_carry),
+            )
+        self._flush_installs()
+        with self.metrics.timer("decode_step"), span(
+            "speculative_rounds", self.spans, batch=int(active.sum()),
+        ):
+            pack_d, tok_d, self.cache, self.draft_cache = (
+                self._spec_rounds_fn(
+                    self.params, self.draft[1], tokens_dev,
+                    self.cache, self.draft_cache, jnp.asarray(spec),
+                    jnp.asarray(active), jnp.asarray(eos_ids),
+                    jnp.asarray(budget), self._next_key(), sp,
+                )
+            )
+        self._spec_carry = tok_d
+        self._spec_carry_ok = self._spec_carry_ok | active
+        # Conservative in-flight charge: the tick can deliver at most
+        # min(R*(k+1), budget) per row.
+        pend = np.minimum(R * (k + 1), budget).astype(np.int32) * active
+        return (pack_d, active, spec, pend, list(self.slots))
+
+    def _spec_resolve(self, produced, prev) -> None:
+        """Fetch and deliver the previous speculative tick's tokens (the
+        packed single-array copy overlaps the tick just dispatched)."""
+        if prev is None:
+            return
+        pack_d, active, spec, _pend, gids = prev
+        k = self.ecfg.speculative_k
+        with self.metrics.timer("decode_resolve"):
+            pack = np.asarray(jax.device_get(pack_d))  # [R, B, k+3]
+        emits = pack[:, :, : k + 1]
+        accs = pack[:, :, k + 1]
+        palive = pack[:, :, k + 2]
+        delivered_total = 0
+        for slot, gid in enumerate(gids):
+            if gid is None or not active[slot]:
+                continue
+            s = self.sessions.get(gid)
+            if s is None or self.slots[slot] != gid:
+                continue  # cancelled/reaped since dispatch
+            emitted_in_graph = int((emits[:, slot] != -1).sum())
+            delivered = 0
+            for r in range(emits.shape[0]):
+                for j in range(k + 1):
+                    if s.state != SessionState.ACTIVE:
+                        break
+                    tok = int(emits[r, slot, j])
+                    if tok == -1:
+                        break
+                    self._deliver(s, tok, produced)
+                    delivered += 1
+            delivered_total += delivered
+            if delivered < emitted_in_graph:
+                # Host-side stop mid-pack: the device carry token sits
+                # beyond the session's true last token.
+                self._spec_carry_ok[slot] = False
+            if spec[slot]:
+                rounds_run = int(palive[:, slot].sum())
+                self.spec_stats["proposed"] += k * rounds_run
+                self.spec_stats["accepted"] += int(
+                    (accs[:, slot] * palive[:, slot]).sum()
+                )
+                self.spec_stats["steps"] += rounds_run
+        self.metrics.counter("decode_tokens", delivered_total)
 
     def _speculative_tick(self, produced) -> None:
         """Draft-propose + ONE-forward verify (greedy speculation): the
@@ -1477,6 +1805,8 @@ class InferenceEngine:
             # The device carry holds THIS session's last token; the slot's
             # next tenant must be fed its own fresh token.
             self._carry_ok[s.slot] = False
+            if self.draft is not None:
+                self._spec_carry_ok[s.slot] = False
             s.slot = None
         if isinstance(self.cache, PagedKVCache) and s.pages:
             if self.ccfg.prefix_caching:

@@ -333,15 +333,25 @@ def save_safetensors(state: Mapping[str, Any], path: str) -> None:
     )
 
 
-def load_config(model_dir: str, validate: bool = True) -> ModelConfig:
+def load_config(
+    model_dir: str,
+    validate: bool = True,
+    resolve: Optional[Callable[[str], Optional[str]]] = None,
+) -> ModelConfig:
     """``config.json`` → :class:`ModelConfig` (the ``AutoConfig`` role,
     ``utils/model.py:83``, without requiring transformers).
 
     ``validate`` checks the model family against the registry — an
     unsupported ``model_type`` fails HERE rather than silently running the
-    llama program over a foreign architecture's weights.
+    llama program over a foreign architecture's weights. ``resolve`` lets a
+    remote resolver (``utils/hub.py``) fetch the config like any other
+    checkpoint file.
     """
-    with open(os.path.join(model_dir, "config.json")) as f:
+    resolve = resolve or _default_resolve(model_dir)
+    path = resolve("config.json")
+    if path is None:
+        raise FileNotFoundError(f"no config.json under {model_dir!r}")
+    with open(path) as f:
         cfg = ModelConfig.from_hf_config(json.load(f))
     if validate:
         from ..models import registry

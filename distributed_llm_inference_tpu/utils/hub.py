@@ -1,0 +1,134 @@
+"""Remote checkpoint streaming: an HTTP(S) ``resolve`` hook for the loader.
+
+The reference's loader pulls the index and every needed shard straight from
+the HuggingFace hub via ``cached_file``
+(``/root/reference/distributed_llm_inference/utils/model.py:27-34,47-50``);
+our loader (``utils/checkpoint.py``) parameterizes filename→path lookup with
+a ``resolve`` callable. :class:`HttpResolver` implements it over plain
+HTTP(S): on first request a file streams into a local content cache
+(resumable — interrupted downloads continue with a ``Range`` request from
+the partial file's length) and every later request is a cache hit, so a
+worker can cold-start onto a fresh host with nothing but a URL: the index
+downloads first, ``weight_map`` prefix filtering picks the node's shards,
+and ONLY those shards ever cross the network (a 70B mid-pipeline node pulls
+its ~GBs, not the checkpoint).
+
+stdlib ``urllib`` only — no hub SDK dependency; :func:`hub_resolver` builds
+the HF-hub URL layout (``{endpoint}/{repo_id}/resolve/{revision}``) on top.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+__all__ = ["HttpResolver", "hub_resolver"]
+
+_CHUNK = 1 << 20  # 1 MiB read chunks
+
+
+class HttpResolver:
+    """``resolve(name) -> local path`` backed by ``base_url``.
+
+    Missing files (HTTP 404) return ``None`` — exactly the contract
+    :func:`utils.checkpoint.find_index` probes its pattern list with.
+    Other HTTP/network failures raise (a worker must not silently treat an
+    unreachable registry as an absent checkpoint).
+    """
+
+    def __init__(self, base_url: str, cache_dir: str, timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.cache_dir = cache_dir
+        self.timeout = timeout
+        os.makedirs(cache_dir, exist_ok=True)
+
+    def _url(self, name: str) -> str:
+        return f"{self.base_url}/{urllib.parse.quote(name)}"
+
+    def __call__(self, name: str) -> Optional[str]:
+        local = os.path.join(self.cache_dir, name.replace("/", os.sep))
+        if os.path.exists(local):
+            return local
+        part = f"{local}.part"
+        # Per-process scratch: two nodes sharing a cache dir (co-located
+        # pipeline stages, same --model URL) must not interleave writes
+        # into one file; the shared ``.part`` is only ever a read-only
+        # resume SOURCE and an atomically-replaced checkpoint.
+        tmp = f"{part}.{os.getpid()}"
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        offset = 0
+        if os.path.exists(part):
+            with open(part, "rb") as src, open(tmp, "wb") as dst:
+                while True:
+                    chunk = src.read(_CHUNK)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                offset = dst.tell()
+        req = urllib.request.Request(self._url(name))
+        if offset:
+            req.add_header("Range", f"bytes={offset}-")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            if os.path.exists(tmp) and e.code != 416:
+                os.remove(tmp)
+            if e.code == 404:
+                return None
+            if e.code == 416 and offset:
+                # Range past EOF: the partial already holds everything (the
+                # previous run died between the last write and the rename).
+                os.replace(tmp, local)
+                return local
+            raise
+        try:
+            with resp:
+                # A server ignoring the Range header replays the whole file
+                # (status 200, not 206): restart from zero.
+                resumed = bool(offset) and resp.status == 206
+                expect = resp.headers.get("Content-Length")
+                expect = int(expect) if expect is not None else None
+                mode = "ab" if resumed else "wb"
+                written = offset if resumed else 0
+                with open(tmp, mode) as f:
+                    if not resumed:
+                        f.truncate(0)
+                    while True:
+                        chunk = resp.read(_CHUNK)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        written += len(chunk)
+            if expect is not None and written != (
+                offset + expect if resumed else expect
+            ):
+                # Early FIN: http.client returns short data then b'' rather
+                # than raising, so verify against Content-Length — a
+                # truncated file must never be promoted to the cache.
+                os.replace(tmp, part)  # checkpoint for the next resume
+                raise IOError(
+                    f"truncated download of {name!r}: got {written} bytes"
+                )
+        except Exception:
+            if os.path.exists(tmp):
+                os.replace(tmp, part)  # keep the bytes for resume
+            raise
+        os.replace(tmp, local)  # atomic: readers see whole files only
+        return local
+
+
+def hub_resolver(
+    repo_id: str,
+    cache_dir: str,
+    revision: str = "main",
+    endpoint: str = "https://huggingface.co",
+) -> HttpResolver:
+    """Resolver over the HF hub's ``/{repo}/resolve/{revision}/{file}`` URL
+    layout (the reference's ``cached_file`` route, ``utils/model.py:29``) —
+    or any mirror serving the same path shape via ``endpoint``."""
+    return HttpResolver(
+        f"{endpoint.rstrip('/')}/{repo_id}/resolve/{revision}", cache_dir
+    )
