@@ -71,25 +71,36 @@ TINY = ModelConfig(
 
 def _zero_params(cfg: ModelConfig, dtype=jnp.bfloat16):
     """Device-resident zero weights of the exact model shape (fast to build;
-    decode cost is independent of weight values)."""
+    decode cost is independent of weight values). MoE configs get stacked
+    expert tensors instead of the dense MLP."""
     h, d = cfg.hidden_size, cfg.head_dim
     L, hq, hkv, inter = cfg.num_layers, cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
     z = lambda *s: jnp.zeros(s, dtype)
+    layers = {
+        "attn_norm": jnp.ones((L, h), dtype),
+        "wq": z(L, h, hq * d),
+        "wk": z(L, h, hkv * d),
+        "wv": z(L, h, hkv * d),
+        "wo": z(L, hq * d, h),
+        "mlp_norm": jnp.ones((L, h), dtype),
+    }
+    if cfg.num_experts > 0:
+        e = cfg.num_experts
+        layers.update(
+            router=z(L, h, e),
+            we_g=z(L, e, h, inter),
+            we_u=z(L, e, h, inter),
+            we_d=z(L, e, inter, h),
+        )
+    else:
+        layers.update(
+            wg=z(L, h, inter), wu=z(L, h, inter), wd=z(L, inter, h)
+        )
     return {
         "embed": z(cfg.vocab_size, h),
         "final_norm": jnp.ones((h,), dtype),
         "lm_head": z(h, cfg.vocab_size),
-        "layers": {
-            "attn_norm": jnp.ones((L, h), dtype),
-            "wq": z(L, h, hq * d),
-            "wk": z(L, h, hkv * d),
-            "wv": z(L, h, hkv * d),
-            "wo": z(L, hq * d, h),
-            "mlp_norm": jnp.ones((L, h), dtype),
-            "wg": z(L, h, inter),
-            "wu": z(L, h, inter),
-            "wd": z(L, inter, h),
-        },
+        "layers": layers,
     }
 
 
@@ -541,6 +552,9 @@ PHASES = {
                      "paged_kvq"),
     # StreamingLLM sink ring mid-stream (signature feature) — _sink_phase().
     "sink_1k": None,
+    # Mixtral-per-layer-shape MoE decode through the engine (EP path's first
+    # on-chip number) — _mixtral_moe_phase().
+    "mixtral": None,
     # Draft+verify speculative serving (BASELINE config 5) — _speculative_phase().
     "speculative": None,
     # The SERVING number: InferenceEngine.step() end to end (scheduler,
@@ -632,7 +646,24 @@ def _engine_decode_bench(cfg, params, batch, prompt_len, ticks=4,
         ttfts.append((time.perf_counter() - t1) * 1e3)
         assert any(fin for _, _t, fin in ev)
         eng.collect_finished()
-    return delivered / dt, float(np.percentile(ttfts, 50)), eng.decode_steps
+    # Concurrent-admission burst (r4 batched multi-row prefill): k sessions
+    # submitted together must admit in ONE bucketed dispatch, costing far
+    # less than k sequential single-row prefills.
+    k_burst = min(4, batch)
+    bursts = []
+    for _ in range(3):
+        for _ in range(k_burst):
+            eng.submit([2] * prompt_len,
+                       SamplingOptions(max_new_tokens=1, eos_token_id=-1))
+        t1 = time.perf_counter()
+        eng.step()
+        bursts.append((time.perf_counter() - t1) * 1e3)
+        eng.step()
+        eng.collect_finished()
+    return (
+        delivered / dt, float(np.percentile(ttfts, 50)), eng.decode_steps,
+        float(np.percentile(bursts, 50)), k_burst,
+    )
 
 
 def _spec_engine_bench(cfg, dcfg, params, dparams, batch, prompt_len,
@@ -727,7 +758,7 @@ def _speculative_phase() -> dict:
             )
             # Plain fused-decode engine at the SAME batch: the number
             # speculation must beat.
-            tok_plain, _, _ = _engine_decode_bench(
+            tok_plain, *_ = _engine_decode_bench(
                 cfg, params, batch, prompt_len=prompt, ticks=8,
             )
         except Exception as e:
@@ -792,7 +823,7 @@ def _mistral_phase() -> dict:
             # ticks=10: the 4-tick window (~1 s) made this phase hostage to
             # single tunnel-latency hiccups (measured 1115-2547 tok/s across
             # identical-code runs); a longer window amortizes them.
-            tok_s, ttft, k = _engine_decode_bench(
+            tok_s, ttft, k, *_ = _engine_decode_bench(
                 cfg, params, batch, prompt_len=128 if on_tpu else 16,
                 cache_kind="paged", ticks=10,
             )
@@ -811,6 +842,67 @@ def _mistral_phase() -> dict:
     raise RuntimeError(f"mistral phase failed at every batch: {err}")
 
 
+MIXTRAL_8L = ModelConfig(
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=8,  # the full 32-layer 8-expert stack is ~45 GB int8 — far
+                   # past one v5e's HBM; 8 layers keep the EXACT per-layer
+                   # Mixtral-8x7B shape (8 experts, top-2, GQA) at ~12 GB
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=1000000.0,
+    max_position_embeddings=4096,
+    num_experts=8,
+    num_experts_per_tok=2,
+    family="mixtral",
+)
+
+TINY_MOE = ModelConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16, num_experts=4,
+    num_experts_per_tok=2, family="mixtral", max_position_embeddings=256,
+)
+
+
+def _mixtral_moe_phase() -> dict:
+    """Expert-parallel-capable MoE decode ON CHIP: Mixtral-8x7B per-layer
+    shape (8 experts, top-2 routing, GQA) served through the ENGINE with
+    int8 expert weights + int8 KV — the first on-chip number for the
+    dense-combine MoE decode path (``ops/moe.py``; r3 shipped it
+    mesh-tested but never timed on hardware)."""
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = MIXTRAL_8L if on_tpu else TINY_MOE
+    params = _zero_qparams(cfg, jnp.bfloat16 if on_tpu else jnp.float32)
+    jax.block_until_ready(params)
+    err = None
+    for batch in ((64, 48, 32) if on_tpu else (4,)):
+        try:
+            tok_s, ttft, k, *_ = _engine_decode_bench(
+                cfg, params, batch, prompt_len=128 if on_tpu else 16,
+                ticks=8,
+            )
+        except Exception as e:
+            err = repr(e)
+            continue
+        return {
+            "tok_s": round(tok_s, 2), "batch": batch,
+            "experts": cfg.num_experts,
+            "experts_per_token": cfg.num_experts_per_tok,
+            "layers": cfg.num_layers, "weights": "int8",
+            "ttft_ms": round(ttft, 2), "decode_steps": k,
+            "scope": "InferenceEngine.step() end to end",
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0].device_kind),
+            "model": (
+                "mixtral-8x7b-shape-8layer" if on_tpu else
+                "tiny-moe-cpu-fallback"
+            ),
+        }
+    raise RuntimeError(f"mixtral phase failed at every batch: {err}")
+
+
 def _engine_phase() -> dict:
     """Serving throughput through the scheduler at int8+int8KV. b72 is the
     largest batch whose ENGINE program the platform compiler accepts (b>=88
@@ -827,7 +919,7 @@ def _engine_phase() -> dict:
     out = None
     for batch in ((72, 64) if on_tpu else (8,)):
         try:
-            tok_s, ttft, k = _engine_decode_bench(
+            tok_s, ttft, k, burst_ms, k_burst = _engine_decode_bench(
                 cfg, params, batch, prompt_len=128 if on_tpu else 16
             )
         except Exception as e:
@@ -837,6 +929,8 @@ def _engine_phase() -> dict:
             "tok_s": round(tok_s, 2), "batch": batch, "weights": "int8",
             "prompt_len": 128 if on_tpu else 16,
             "ttft_ms": round(ttft, 2), "decode_steps": k,
+            "admit_burst_ms": round(burst_ms, 2),
+            "admit_burst_sessions": k_burst,
             "scope": "InferenceEngine.step() end to end",
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0].device_kind),
@@ -850,7 +944,7 @@ def _engine_phase() -> dict:
         # prompt-64/T-192 admits batch 96 — where the ENGINE exceeds the raw
         # b112 headline (3218 measured vs raw 3193).
         try:
-            tok_s, ttft, _ = _engine_decode_bench(
+            tok_s, ttft, *_ = _engine_decode_bench(
                 cfg, params, 96, prompt_len=64
             )
             out["short_ctx"] = {
@@ -879,6 +973,8 @@ def run_phase(name: str) -> dict:
         return _speculative_phase()
     if name == "mistral_paged_swa":
         return _mistral_phase()
+    if name == "mixtral":
+        return _mixtral_moe_phase()
     build, ladder, cache_cls = PHASES[name]
     # float32 on CPU throughout: XLA:CPU lacks several bf16 kernels the
     # quantized paths emit.
@@ -988,7 +1084,7 @@ def main():
     # number is measured at acceptance=1.0 by construction and the sink ring
     # reads a bounded window — neither is comparable decode work.
     _NON_HEADLINE = {"speculative", "sink_1k", "llama3_8b_int8_kvq",
-                     "mistral_paged_swa"}
+                     "mistral_paged_swa", "mixtral"}
     best_dtype = max(
         (n for n in results if n not in _NON_HEADLINE),
         key=lambda n: results[n]["tok_s"],

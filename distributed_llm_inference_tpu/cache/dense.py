@@ -159,6 +159,35 @@ class _DenseRowsMixin(GatherAttendMixin):
             for name in self._fields()
         })
 
+    def select_rows(self, rows):
+        """Compact ``len(rows)``-row view (jit-safe, ``rows`` traced int32
+        ``[NR]``). Padding entries use an OUT-OF-RANGE row index: the
+        gather clamps them (content irrelevant — their ``num_new = 0``
+        prefill never writes) and :meth:`merge_rows` drops their
+        write-back. (Padding by DUPLICATING a real row corrupts it: a
+        duplicate-index scatter with differing values is undefined-order,
+        and the stale pad copy can win over the real row's fresh KV.) The
+        batched-admission prefill runs ONE bucketed dispatch over k
+        freshly admitted sessions instead of k sequential single-row
+        prefills (each a full weight sweep + a tunnel round trip)."""
+        def take(name):
+            ax = self.BATCH_AXES[name]
+            return jnp.take(getattr(self, name), rows, axis=ax, mode="clip")
+
+        return self.replace(**{name: take(name) for name in self._fields()})
+
+    def merge_rows(self, sub, rows):
+        """Scatter a :meth:`select_rows` sub-cache back; out-of-range
+        (padding) rows drop."""
+        def put(name):
+            ax = self.BATCH_AXES[name]
+            idx = (slice(None),) * ax + (rows,)
+            return getattr(self, name).at[idx].set(
+                getattr(sub, name), mode="drop"
+            )
+
+        return self.replace(**{name: put(name) for name in self._fields()})
+
     def _write(self, layer_buf, new_vals, num_new):
         """Merge incoming ``[B, S, ...]`` rows into ``[B, T, ...]`` at each
         row's write offset (``lengths``)."""
@@ -433,12 +462,20 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         return self.k.shape[3]
 
     @property
+    def _kernel_tail_ok(self) -> bool:
+        """Kernel-mode fused tail requires a 32-aligned time axis: the
+        io-aliased whole-stack operands cannot be padded (engine buffers
+        are always 32-aligned via the window ladder; direct API users with
+        odd buffers keep the XLA segments path end to end)."""
+        return self.use_kernel and self.max_len % 32 == 0
+
+    @property
     def tail_reads_whole_big(self) -> bool:
         """Fused decode passes the big K/V stacks UNSLICED (plus a layer
         index) so the Pallas kernel reads the cache in place — slicing a
         layer out of the stack to feed a custom call copies it through HBM
         every (layer, step), which measured ~3x decode cost at batch 112."""
-        return self.use_kernel
+        return self._kernel_tail_ok
 
     @property
     def layer_stacks(self):
@@ -613,12 +650,12 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         tail stacks pass through as io-aliased operands (no per-layer
         slicing in the scan), the step's K/V quantize in-kernel, and the
         tail is the final online-softmax tile."""
-        return self.use_kernel
+        return self._kernel_tail_ok
 
     def tail_init(self, k_steps: int):
         l, b, h, t, d = self.k.shape
         zs = jnp.zeros((l, b, h, k_steps), jnp.float32)
-        if self.use_kernel:
+        if self._kernel_tail_ok:
             # Distinct buffers: the fused kernel aliases each tail operand
             # to an output; a shared k/v zeros array cannot be donated twice.
             return (
@@ -642,7 +679,7 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         tk, tv, tks, tvs = tail_state
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
-        if self.use_kernel and q.shape[1] == 1:
+        if self._kernel_tail_ok and q.shape[1] == 1:
             # Everything in ONE Pallas call: the step's K/V quantize
             # in-kernel and land in the io-aliased whole-stack tail, and
             # the tail joins the big sweep as the final online-softmax
@@ -697,7 +734,7 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         """Per-row K-token window merge (head-major: time axis 2 of the
         ``[L, Hkv, T(, D)]`` row view)."""
         wk, wv, wks, wvs = tail  # [L, B, Hkv, K, D] / [L, B, Hkv, K]
-        if self.use_kernel and self.max_len % 32 == 0:
+        if self._kernel_tail_ok:
             # Blocked RMW merge: the XLA where/take rewrite of the whole
             # big buffers costs ~58 ms per fused call at batch 112. (Tiny
             # non-32-multiple buffers keep the XLA path.)

@@ -365,6 +365,30 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             ),
         )
 
+    def select_rows(self, rows) -> "PagedKVCache":
+        """Compact multi-row view for the batched-admission prefill (see
+        ``cache/dense.py`` — padding entries are out-of-range rows, clamped
+        here and dropped on merge): row-local tables/lengths over the
+        SHARED page pool, so the sub-prefill writes straight into the
+        pool. A clamped padding row's table is harmless: its ``num_new=0``
+        prefill diverts every write to the null page."""
+        return self.replace(
+            page_table=jnp.take(self.page_table, rows, axis=0, mode="clip"),
+            lengths=jnp.take(self.lengths, rows, axis=0, mode="clip"),
+        )
+
+    def merge_rows(self, sub, rows):
+        updated = {
+            name: getattr(sub, name) for name in self.SHARED_FIELDS
+        }
+        return self.replace(
+            page_table=self.page_table.at[rows].set(
+                sub.page_table, mode="drop"
+            ),
+            lengths=self.lengths.at[rows].set(sub.lengths, mode="drop"),
+            **updated,
+        )
+
     def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
         """Host-side helper: install allocator-chosen page ids for a row.
 
@@ -762,21 +786,33 @@ class QuantizedPagedKVCache(PagedKVCache):
         )
 
     @property
+    def _kernel_tail_ok(self) -> bool:
+        """The gathered fused path feeds ``quantized_fused_decode_attention``
+        whose io-aliased operands cannot pad — its time axis (= table
+        capacity here) must be a 32 multiple, like the dense cache's gate;
+        the in-place whole-pool kernel tiles by page instead and has no
+        such constraint. Odd capacities (e.g. page_size 8 x 5 slots) keep
+        the XLA segments path."""
+        return self.use_kernel and (
+            self._fused_inplace or self.max_len % 32 == 0
+        )
+
+    @property
     def tail_reads_whole_big(self) -> bool:
         """Kernel mode: the GATHERED contiguous stacks pass to the fused
         kernel whole (+ layer index) — slicing a layer out of them to feed
         the custom call would copy it through HBM every (layer, step)."""
-        return self.use_kernel
+        return self._kernel_tail_ok
 
     @property
     def tail_in_kernel(self) -> bool:
-        return self.use_kernel
+        return self._kernel_tail_ok
 
     def tail_init(self, k_steps: int):
         l = self.k_pages.shape[0]
         b = self.page_table.shape[0]
         hkv, d = self.k_pages.shape[2], self.k_pages.shape[4]
-        if self.use_kernel:
+        if self._kernel_tail_ok:
             # int8 + scale planes, quantized IN-KERNEL with the same
             # symmetric absmax scheme ``_scatter_q`` uses — the flush
             # scatters these planes into the pool directly, so pool
@@ -801,7 +837,7 @@ class QuantizedPagedKVCache(PagedKVCache):
 
         q_rot = apply_rope(q, rope.cos, rope.sin)
         k_rot = apply_rope(k_new, rope.cos, rope.sin)
-        if self.use_kernel and q.shape[1] == 1:
+        if self._kernel_tail_ok and q.shape[1] == 1:
             gk, gv, gks, gvs, lidx = big_state  # whole [L, ...] + layer idx
             tk, tv, tks, tvs = tail_state
             if self._fused_inplace:

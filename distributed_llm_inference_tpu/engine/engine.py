@@ -335,6 +335,24 @@ class InferenceEngine:
             _, sub = llama.model_apply(cfg, params, tokens, sub, n_valid[None], **mkw)
             return cache.merge_row(sub, row)
 
+        def _prefill_rows(params, tokens, cache, rows, n_valid, key, sp):
+            """Batched admission: k sessions' prompts in ONE bucketed
+            dispatch over a compact k-row sub-cache (``tokens [k, S]``,
+            ``rows``/``n_valid`` ``[k]`` traced — one executable per
+            (k-bucket, prompt-bucket)). k sequential single-row prefills
+            cost k weight sweeps at ~25% MFU each plus k tunnel round
+            trips; batched rows share every weight fetch."""
+            sub = cache.select_rows(rows)
+            logits, sub = llama.model_apply(
+                cfg, params, tokens, sub, n_valid, **mkw
+            )
+            cache = cache.merge_rows(sub, rows)
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            toks = sample(last, key, sp)
+            return toks, cache
+
         def _decode_step(params, tokens, cache, active, key, sp):
             logits, cache = llama.model_apply(
                 cfg, params, tokens, cache, active.astype(jnp.int32),
@@ -426,6 +444,14 @@ class InferenceEngine:
         dk = dict(donate_argnums=(2,)) if donate else {}
         self._prefill = self._with_mesh(jax.jit(_prefill_row, **dk))
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
+        self._prefill_batch = jax.jit(_prefill_rows, **dk)
+        # Batched admission needs select_rows/merge_rows (gather/scatter over
+        # the batch axis) and a single-device computation: a scatter over a
+        # dp/pp-sharded batch aborts under GSPMD, and ring prefill is a
+        # different program entirely.
+        self._batch_admission = (
+            self.mesh is None and hasattr(self.cache, "select_rows")
+        )
         self._decode = self._with_mesh(jax.jit(_decode_step, **dk))
         self._decode_k = self._with_mesh(jax.jit(_decode_scan, **dk))
 
@@ -802,29 +828,50 @@ class InferenceEngine:
 
     def _queue_install(self, row: int, slot_idx: int, page: int) -> None:
         """Defer a page-table install; :meth:`_flush_installs` applies every
-        pending one in a single batched dispatch. Mesh-sharded tables keep
-        the chained per-page path (a scatter over a sharded table aborts
-        under GSPMD)."""
-        if getattr(self, "mesh", None) is not None:
-            self.cache = self.cache.assign_pages(row, [page], slot_idx)
-            return
+        pending one in a single batched dispatch (mesh-sharded tables:
+        one dynamic-update-slice per CONTIGUOUS per-row run — a scatter
+        over a sharded table aborts under GSPMD, but chaining one dispatch
+        per page paid a tunnel round trip each)."""
         self._pending_installs.append((row, slot_idx, page))
 
     def _flush_installs(self) -> None:
         if not self._pending_installs:
             return
-        rows = [r for r, _, _ in self._pending_installs]
-        slots_ = [si for _, si, _ in self._pending_installs]
-        pages = [p for _, _, p in self._pending_installs]
+        pending = self._pending_installs
         self._pending_installs = []
+        if getattr(self, "mesh", None) is not None:
+            # Group each row's pages into contiguous slot runs: one
+            # assign_pages (a DUS, GSPMD-safe) per run instead of per page.
+            runs: List[Tuple[int, int, List[int]]] = []
+            for row, slot_idx, page in pending:
+                if (
+                    runs
+                    and runs[-1][0] == row
+                    and runs[-1][1] + len(runs[-1][2]) == slot_idx
+                ):
+                    runs[-1][2].append(page)
+                else:
+                    runs.append((row, slot_idx, [page]))
+            for row, start, pages in runs:
+                self.cache = self.cache.assign_pages(row, pages, start)
+            return
+        rows = [r for r, _, _ in pending]
+        slots_ = [si for _, si, _ in pending]
+        pages = [p for _, _, p in pending]
         # Exactly TWO pad buckets (both pre-compiled by _warm_table_write):
         # small flushes (one admission's prompt pages) and everything else.
         # Arbitrary pow2 pads would each compile mid-serving the first time
-        # a new length appeared (~2 s remote-compile stall).
-        pad = 4 if len(rows) <= 4 else self._install_bucket()
-        self.cache = self.cache.assign_pages_batch(
-            rows, slots_, pages, pad_to=pad
-        )
+        # a new length appeared (~2 s remote-compile stall). A flush larger
+        # than the big bucket (growth tick + oversized admission backlog in
+        # one tick) splits into bucket-sized chunks — each a warmed
+        # executable — instead of silently compiling an unwarmed length.
+        big = self._install_bucket()
+        while rows:
+            n = 4 if len(rows) <= 4 else big
+            self.cache = self.cache.assign_pages_batch(
+                rows[:n], slots_[:n], pages[:n], pad_to=n
+            )
+            rows, slots_, pages = rows[n:], slots_[n:], pages[n:]
 
     def _reshard_cache(self) -> None:
         """Re-apply the mesh shardings after a growth/shrink re-created the
@@ -1019,6 +1066,7 @@ class InferenceEngine:
                 s.finish_reason = "cancelled"
                 self._release(s)
         self._shrink_if_idle()
+        admitted: List[Tuple[Session, int]] = []
         for slot in range(self.batch):
             if self.slots[slot] is not None:
                 continue
@@ -1081,7 +1129,84 @@ class InferenceEngine:
             s.slot = slot
             s.state = SessionState.ACTIVE
             self.slots[slot] = s.generation_id
-            self._run_prefill(s, produced, skip=shared_len)
+            admitted.append((s, shared_len))
+        self._dispatch_prefills(admitted, produced)
+
+    def _dispatch_prefills(self, admitted, produced) -> None:
+        """Prefill freshly admitted sessions: same-bucket groups of >= 2
+        simple prompts (no chunking, no shared-prefix skip, no ring path)
+        go through ONE batched dispatch each; the rest keep the single-row
+        path."""
+        if not admitted:
+            return
+        singles: List[Tuple[Session, int]] = []
+        groups: Dict[int, List[Session]] = {}
+        chunk_cap = self._max_chunk()
+        for s, skip in admitted:
+            ring = (
+                self._ring_prefill is not None
+                and len(s.prompt) > self._ring_threshold()
+            )
+            if (
+                self._batch_admission
+                and skip == 0
+                and not ring
+                and len(s.prompt) <= chunk_cap
+            ):
+                groups.setdefault(
+                    self._bucket_for(len(s.prompt)), []
+                ).append(s)
+            else:
+                singles.append((s, skip))
+        for bucket, group in groups.items():
+            if len(group) < 2:
+                singles.extend((s, 0) for s in group)
+                continue
+            while group:
+                self._prefill_group(group[:8], bucket, produced)
+                group = group[8:]
+        for s, skip in singles:
+            self._run_prefill(s, produced, skip=skip)
+
+    def _prefill_group(self, group, bucket, produced) -> None:
+        """One batched prefill dispatch for <= 8 same-bucket sessions.
+        Rows pad to a power of two (duplicating row 0 with ``n_valid = 0``
+        — a no-write, no-deliver placeholder) so a handful of executables
+        covers every admission burst."""
+        self._flush_installs()
+        k = len(group)
+        nr = 2
+        while nr < k:
+            nr *= 2
+        # Padding entries use an OUT-OF-RANGE row: select_rows clamps the
+        # gather, merge_rows drops the write-back (duplicating a real row
+        # instead makes the scatter undefined-order and can clobber it
+        # with stale pre-prefill content).
+        rows = np.full((nr,), self.batch, np.int32)
+        n_valid = np.zeros((nr,), np.int32)
+        tokens = np.zeros((nr, bucket), np.int32)
+        opts = [SamplingOptions()] * nr
+        for i, s in enumerate(group):
+            rows[i] = s.slot
+            n_valid[i] = len(s.prompt)
+            tokens[i, : len(s.prompt)] = s.prompt
+            opts[i] = s.options
+        sp = SamplingParams.stack(opts)
+        with self.metrics.timer("prefill"), span(
+            "prefill_batch", self.spans, sessions=k,
+            prompt_tokens=int(n_valid.sum()),
+        ):
+            toks, self.cache = self._prefill_batch(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(rows), jnp.asarray(n_valid),
+                self._next_key(), sp,
+            )
+            toks = np.asarray(jax.device_get(toks))
+        self.metrics.counter("batched_prefills", k)
+        for i, s in enumerate(group):
+            self._finish_prefill(
+                s, int(toks[i]), np.asarray(s.prompt, np.int32), produced, 0
+            )
 
     def _ring_threshold(self) -> int:
         thr = self.ecfg.ring_prefill_threshold

@@ -49,6 +49,16 @@ class HttpResolver:
         return f"{self.base_url}/{urllib.parse.quote(name)}"
 
     def __call__(self, name: str) -> Optional[str]:
+        # Names come from DOWNLOADED indexes (weight_map values) — reject
+        # traversal so a hostile checkpoint cannot write outside the cache
+        # (backslashes rejected outright: no real checkpoint uses them, and
+        # they would separate paths on Windows).
+        if (
+            name.startswith("/")
+            or "\\" in name
+            or ".." in name.split("/")
+        ):
+            raise ValueError(f"unsafe checkpoint file name: {name!r}")
         local = os.path.join(self.cache_dir, name.replace("/", os.sep))
         if os.path.exists(local):
             return local

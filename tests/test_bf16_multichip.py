@@ -1,0 +1,120 @@
+"""The bf16 multi-chip serving program must keep LOWERING with its
+collectives — closing README's validation-envelope caveat as far as this
+environment allows.
+
+The 8-device CPU test mesh runs fp32 only: XLA:CPU's AllReducePromotion
+pass hard-aborts (CHECK failure, process death) when COMPILING a bf16
+all-reduce, so the bf16 tp×pp program — the one a real pod serves — was
+previously never validated anywhere. Here it is traced and LOWERED on the
+CPU mesh (catching bf16-specific tracing/sharding regressions: dtype
+mismatches, collective layouts, pipeline ppermute emission), with the
+lowered text asserted to carry bf16 types, the pipeline's
+collective-permute, and the tp shardings GSPMD partitions into bf16
+all-reduces on TPU. The compile step itself is attempted in a THROWAWAY
+SUBPROCESS: on a backend where it works (TPU; a fixed XLA:CPU) the test
+also asserts the partitioned collectives, and on today's XLA:CPU the
+abort is contained and documented instead of killing the test runner.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llm_inference_tpu.cache.dense import DenseKVCache
+from distributed_llm_inference_tpu.config import MeshConfig, ModelConfig
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.parallel import mesh as mesh_lib
+from distributed_llm_inference_tpu.parallel import tp
+from distributed_llm_inference_tpu.parallel.pipeline import (
+    pipeline_block_apply,
+)
+
+CFG = ModelConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=4,
+    num_heads=4, num_kv_heads=2, head_dim=16, max_position_embeddings=128,
+)
+
+
+def _lower_bf16_step():
+    mesh = mesh_lib.build_mesh(MeshConfig(dp=2, pp=2, tp=2))
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.bfloat16)
+    params = tp.shard_pytree(
+        params, mesh, tp.param_pspecs(params, use_pp=True)
+    )
+    cache = DenseKVCache.create(4, 8, 32, 2, 16, jnp.bfloat16)
+    cache = tp.shard_pytree(
+        cache, mesh, tp.cache_pspecs(cache, use_pp=True)
+    )
+    tokens = jnp.ones((8, 1), jnp.int32)
+    num_new = jnp.ones((8,), jnp.int32)
+
+    def block_fn(cfg_, layers_, x_, cache_, nn_):
+        return pipeline_block_apply(cfg_, layers_, x_, cache_, nn_, mesh)
+
+    def step(p, t, c, n):
+        return llama.model_apply(CFG, p, t, c, n, block_fn=block_fn)
+
+    with mesh:
+        return jax.jit(step).lower(params, tokens, cache, num_new)
+
+
+def test_bf16_tp_pp_program_lowers_with_collectives():
+    """Fails if the bf16 tp×pp×dp serving step stops lowering, or if the
+    pipeline's explicit collective disappears from the lowered module."""
+    text = _lower_bf16_step().as_text()
+    assert "bf16" in text, "serving step no longer carries bf16 operands"
+    assert "collective_permute" in text, (
+        "pipeline ppermute missing from the lowered bf16 program"
+    )
+    # tp shardings present for GSPMD to partition into all-reduces.
+    assert "sharding" in text
+
+
+def test_bf16_tp_pp_program_compiles_where_backend_allows():
+    """Attempt the full SPMD compile in a subprocess. On a backend whose
+    compiler accepts bf16 all-reduces (TPU, or a fixed XLA:CPU) the
+    partitioned program must contain them; on today's XLA:CPU the known
+    AllReducePromotion CHECK-abort is tolerated (and pinned — if it goes
+    away, the stronger assertion takes over automatically)."""
+    snippet = textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import sys
+        sys.path.insert(0, %r)
+        from tests.test_bf16_multichip import _lower_bf16_step
+        compiled = _lower_bf16_step().compile()
+        text = compiled.as_text()
+        assert "all-reduce" in text, "no all-reduce in partitioned program"
+        assert "bf16" in text
+        print("COMPILED_WITH_COLLECTIVES")
+    """) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", snippet], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if proc.returncode == 0:
+        assert "COMPILED_WITH_COLLECTIVES" in proc.stdout
+    else:
+        # The contained abort must be the KNOWN bf16 promotion crash, not
+        # some new failure mode.
+        blob = proc.stdout + proc.stderr
+        assert (
+            "AllReduce" in blob or "all-reduce" in blob
+            or proc.returncode < 0  # CHECK-abort (SIGABRT)
+        ), f"unexpected compile failure rc={proc.returncode}: {blob[-1500:]}"
+        pytest.xfail(
+            "XLA:CPU still aborts compiling bf16 all-reduce "
+            "(known promotion-pass CHECK); lowering test covers bf16"
+        )

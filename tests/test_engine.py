@@ -671,3 +671,78 @@ def test_pipelined_paged_matches_sync():
     assert eng._pipelined
     assert eng.generate(ps, opts) == ref
     assert eng.allocator.free_count == 63  # all pages back (minus null page)
+
+
+def test_batched_admission_matches_single_row_prefill():
+    """r4 batched multi-row prefill: a burst of admissions goes through ONE
+    bucketed dispatch per prompt-bucket group (counted via the
+    batched_prefills metric) and produces EXACTLY the tokens the
+    single-row path produces, across cache kinds."""
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    # 5 prompts over batch 4: the first admission wave is a FULL group of
+    # 4 (no padding) and, after one retires, a later wave plus the 3-prompt
+    # case below covers PADDED groups (3 -> nr 4), whose pad rows must not
+    # clobber a real row's prefill (r4 review finding: duplicate-index
+    # scatters are undefined-order).
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16, 17],
+               [21, 22], [31, 32, 33]]
+    opts = SamplingOptions(max_new_tokens=8, temperature=0.0)
+
+    def run(kind, kv_quant, force_single):
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch_size=4, max_seq_len=64, dtype="float32",
+                         prefill_buckets=(8, 16)),
+            CacheConfig(kind=kind, kv_quant=kv_quant, num_pages=24,
+                        page_size=8, max_pages_per_session=8),
+        )
+        if force_single:
+            eng._batch_admission = False
+        out = eng.generate(prompts, opts)
+        return out, eng.metrics.snapshot()
+
+    for kind, kv in (("dense", "int8"), ("paged", "int8")):
+        single, _ = run(kind, kv, True)
+        batched, counters = run(kind, kv, False)
+        assert single == batched, (kind, kv)
+        assert counters.get("batched_prefills", 0) >= 4, (kind, counters)
+
+
+def test_batched_admission_padded_group_preserves_every_row():
+    """3 same-bucket admissions pad to a 4-row dispatch: the pad row is
+    OUT-OF-RANGE (clamped gather, dropped scatter) — padding by
+    duplicating a real row made the merge scatter undefined-order and
+    clobbered row 0's freshly written prompt KV with stale content
+    (caught by review, reproduced: row 0's stream diverged after a few
+    tokens)."""
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13]]
+    opts = SamplingOptions(max_new_tokens=8, temperature=0.0)
+
+    def run(kind, force_single):
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch_size=4, max_seq_len=64, dtype="float32",
+                         prefill_buckets=(8,)),
+            CacheConfig(kind=kind, kv_quant="int8", num_pages=24,
+                        page_size=8, max_pages_per_session=8),
+        )
+        if force_single:
+            eng._batch_admission = False
+        out = eng.generate(prompts, opts)
+        return out, eng.metrics.snapshot()
+
+    for kind in ("dense", "paged"):
+        single, _ = run(kind, True)
+        batched, counters = run(kind, False)
+        assert single == batched, (kind, single, batched)
+        assert counters.get("batched_prefills", 0) == 3, counters
+

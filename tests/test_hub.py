@@ -191,3 +191,11 @@ def test_cold_start_serving_end_to_end(ckpt_server, tmp_path):
         [[1, 2, 3]], SamplingOptions(max_new_tokens=4, temperature=0.0)
     )
     assert len(out[0]) == 4
+
+
+def test_resolver_rejects_path_traversal(tmp_path):
+    """A hostile index's weight_map must not write outside the cache."""
+    r = HttpResolver("http://127.0.0.1:1", str(tmp_path / "c"))
+    for bad in ("../evil", "a/../../evil", "/etc/passwd", "..\\evil"):
+        with pytest.raises(ValueError):
+            r(bad)

@@ -196,3 +196,29 @@ def test_engine_quantized_sink_kernel_matches_xla():
     assert [len(g) for g in q_xla] == [40, 40, 40]
     bf = run(None, False)
     assert [len(g) for g in bf] == [40, 40, 40]
+
+
+def test_engine_mesh_kernel_matches_mesh_xla():
+    """ADVICE r3: the fused whole-stack kernel had no numerical-parity
+    coverage under a tp mesh (the auto-on resolution enables it for
+    mesh-sharded int8 dense engines on TPU). The invariant that matters:
+    on the SAME (dp x tp) mesh, kernel and XLA decode paths emit identical
+    tokens (mesh-vs-solo drift is psum reassociation near-ties, present in
+    both paths equally)."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    params = _params()
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13]]
+    opts = SamplingOptions(max_new_tokens=10, temperature=0.0)
+
+    def run(use_pallas):
+        eng = InferenceEngine(
+            CFG, params,
+            EngineConfig(max_batch_size=4, max_seq_len=64, dtype="float32",
+                         use_pallas_attention=use_pallas),
+            CacheConfig(kind="dense", kv_quant="int8"),
+            mesh_cfg=MeshConfig(dp=2, tp=2),
+        )
+        return eng.generate(prompts, opts)
+
+    assert run(True) == run(False)
