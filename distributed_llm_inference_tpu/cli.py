@@ -119,9 +119,25 @@ def cmd_serve(args) -> int:
     from .utils import checkpoint
 
     host, port = _parse_relay(args.relay)
-    first, last = _parse_layers(args.layers)
     resolve, _ = _model_source(args)
     cfg = checkpoint.load_config(args.model, resolve=resolve)
+    if args.layers is not None:
+        first, last = _parse_layers(args.layers)
+    else:
+        # Directory-driven self-selection (the reference's "choose optimal
+        # block ids" intent, server/server.py:8): ask which layers the
+        # deployment needs most — a dead node's lapsed lease re-opens its
+        # range, so a spare started with NO --layers auto-adopts the hole.
+        # Resolved BEFORE loading weights: the node then streams only its
+        # assigned block.
+        from .distributed.directory import DirectoryClient
+
+        with DirectoryClient(port, host) as d:
+            first, last = d.assign(cfg.num_layers, args.max_layers)
+        print(json.dumps({
+            "event": "layers_assigned", "first_layer": first,
+            "last_layer": last,
+        }), flush=True)
     params = checkpoint.load_block_params(
         args.model, cfg, list(range(first, last + 1)),
         jnp.dtype(args.dtype), resolve=resolve, cache_dir=args.weights_cache,
@@ -295,7 +311,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("serve", help="serve a layer block from a checkpoint")
     s.add_argument("--model", required=True)
-    s.add_argument("--layers", required=True, help="half-open range, e.g. 0:16")
+    s.add_argument("--layers", default=None,
+                   help="half-open range, e.g. 0:16; omit to let the "
+                        "DIRECTORY assign the most-needed range (gap fill "
+                        "first, thinnest replication otherwise)")
+    s.add_argument("--max-layers", type=int, default=None,
+                   help="cap on a directory-assigned range (default: the "
+                        "whole model)")
     s.add_argument("--relay", required=True, help="host:port of the relay")
     s.add_argument("--node-id", default=None)
     s.add_argument("--max-sessions", type=int, default=8)

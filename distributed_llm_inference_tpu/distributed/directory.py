@@ -86,6 +86,44 @@ class BlockDirectory:
             self._expire_locked()
             return list(self._nodes.values())
 
+    def assign(self, num_layers: int, span: Optional[int] = None
+               ) -> Tuple[int, int]:
+        """Choose the layer range a JOINING node should serve — the "choose
+        optimal block ids" intent the reference sketched and never built
+        (``/root/reference/distributed_llm_inference/server/server.py:8``).
+
+        Policy, against the LIVE lease table (expired leases have already
+        re-opened their layers, so a dead node's hole is re-advertised
+        here automatically):
+
+        * any uncovered layer → the range starting at the FIRST uncovered
+          layer, extending ``span`` layers (restoring routability beats
+          everything else);
+        * full coverage → the ``span``-wide window with the THINNEST total
+          replication (add redundancy where the chain is most fragile).
+
+        ``span`` (default: whole model) caps how many layers the joining
+        node is willing to hold.
+        """
+        if span is not None and span < 1:
+            raise ValueError(f"span must be positive, got {span}")
+        span = min(span or num_layers, num_layers)
+        cov = [0] * num_layers
+        for n in self.alive():
+            for layer in range(n.first_layer, min(n.last_layer + 1,
+                                                  num_layers)):
+                cov[layer] += 1
+        if 0 in cov:
+            # Start AT the gap (moving the range to fit a full span would
+            # drift away from it); a tail gap simply yields a shorter range.
+            first = cov.index(0)
+            return first, min(first + span, num_layers) - 1
+        sums = [
+            sum(cov[i : i + span]) for i in range(num_layers - span + 1)
+        ]
+        first = min(range(len(sums)), key=sums.__getitem__)
+        return first, first + span - 1
+
     def plan_route(self, num_layers: int) -> List[NodeInfo]:
         """Greedy chain cover of layers ``[0, num_layers)``: at each position
         pick the live node extending coverage furthest (least-loaded on
@@ -154,6 +192,9 @@ class DirectoryService:
             if op == "remove":
                 d.remove(req["node_id"])
                 return {"ok": True}
+            if op == "assign":
+                first, last = d.assign(req["num_layers"], req.get("span"))
+                return {"ok": True, "first_layer": first, "last_layer": last}
             if op == "route":
                 route = d.plan_route(req["num_layers"])
                 return {"ok": True, "route": [
@@ -232,6 +273,14 @@ class DirectoryClient:
 
     def route(self, num_layers: int) -> List[dict]:
         return self._call({"op": "route", "num_layers": num_layers})["route"]
+
+    def assign(self, num_layers: int,
+               span: Optional[int] = None) -> Tuple[int, int]:
+        """Ask the directory which layer range a joining node should serve
+        (see :meth:`BlockDirectory.assign`)."""
+        r = self._call({"op": "assign", "num_layers": num_layers,
+                        "span": span})
+        return r["first_layer"], r["last_layer"]
 
     def alive(self) -> List[dict]:
         return self._call({"op": "alive"})["nodes"]

@@ -810,6 +810,13 @@ class InferenceEngine:
             # the writes themselves would stomp a live row's first page
             # mapping when re-warming after a mid-serving table widen.
             self.cache.assign_pages(0, [0])
+            if self._mesh_cfg is not None:
+                # Mesh installs dispatch binary-decomposed run chunks:
+                # warm every power-of-two length up to the table width.
+                n = 2
+                while n <= self.cache.page_table.shape[1]:
+                    self.cache.assign_pages(0, [0] * n)
+                    n *= 2
             if self._mesh_cfg is None:
                 # Both batched-install pad buckets (_flush_installs) —
                 # mesh engines never dispatch these (their installs stay
@@ -840,8 +847,14 @@ class InferenceEngine:
         pending = self._pending_installs
         self._pending_installs = []
         if getattr(self, "mesh", None) is not None:
-            # Group each row's pages into contiguous slot runs: one
-            # assign_pages (a DUS, GSPMD-safe) per run instead of per page.
+            # Group each row's pages into contiguous slot runs, then split
+            # every run into POWER-OF-TWO chunks: one assign_pages (a DUS,
+            # GSPMD-safe) per chunk. Binary decomposition keeps the set of
+            # dispatched lengths to the pre-warmed {1, 2, 4, ...} ladder —
+            # an arbitrary run length would compile a fresh executable per
+            # length (~2 s remote stall mid-serving), and padding a run to
+            # a bucket cannot work here (the DUS clamps at the table edge
+            # and would shift the write window onto other slots).
             runs: List[Tuple[int, int, List[int]]] = []
             for row, slot_idx, page in pending:
                 if (
@@ -853,7 +866,13 @@ class InferenceEngine:
                 else:
                     runs.append((row, slot_idx, [page]))
             for row, start, pages in runs:
-                self.cache = self.cache.assign_pages(row, pages, start)
+                while pages:
+                    n = 1 << (len(pages).bit_length() - 1)  # largest pow2 <=
+                    self.cache = self.cache.assign_pages(
+                        row, pages[:n], start
+                    )
+                    start += n
+                    pages = pages[n:]
             return
         rows = [r for r, _, _ in pending]
         slots_ = [si for _, si, _ in pending]

@@ -83,11 +83,13 @@ class QuantizedTensor4(struct.PyTreeNode):
     adjacent output channels per byte (even channel in the low nibble). The
     int8 container keeps the pytree leaf a universally supported dtype (the
     tunneled TPU platform can't transfer ``s4`` arrays across the jit
-    boundary); :func:`matmul` reinterprets it in-graph via
-    ``lax.bitcast_convert_type`` to ``int4``, which XLA fuses (bitcast +
-    convert) into the matmul operand read — HBM traffic is the packed half
-    byte per value. ``scale``: fp ``[..., G, out]``. ``shape`` reports the
-    logical ``[..., in, out]``.
+    boundary); :func:`matmul` unpacks the nibbles ARITHMETICALLY
+    (shift + sign-extend, fused into the operand read) — HBM traffic is the
+    packed half byte per value. ``lax.bitcast_convert_type`` to ``int4``
+    must NOT be used here: XLA:TPU interprets the nibbles differently from
+    CPU (measured cos ≈ -0.3 against the fp reference on a real v5e, exact
+    on CPU — caught by ``tools/quant_accuracy.py`` in r4). ``scale``: fp
+    ``[..., G, out]``. ``shape`` reports the logical ``[..., in, out]``.
     """
 
     q: jax.Array
@@ -103,16 +105,15 @@ class QuantizedTensor4(struct.PyTreeNode):
         return self.scale.dtype
 
     def unpack(self) -> jax.Array:
-        """In-graph int4 view ``[..., G, gs, out]`` (low nibble = even
-        channel; bitcast appends a trailing pair axis).
-
-        CAUTION: always pass the tensor INTO jit as an argument — a
-        closure-captured (constant-folded) ``bitcast_convert_type`` to int4
-        miscompiles on XLA:CPU (observed jax 0.9.0); as a traced argument it
-        is correct on both CPU and TPU."""
+        """In-graph int4-valued int8 view ``[..., G, gs, out]`` (low nibble
+        = even channel), via arithmetic shift-and-sign-extend — portable
+        across CPU and TPU (the int4 bitcast is not; see class docstring)."""
         *lead, g, gs, out_packed = self.q.shape
-        q4 = jax.lax.bitcast_convert_type(self.q, jnp.int4)
-        return q4.reshape(*lead, g, gs, out_packed * 2)
+        lo = jnp.right_shift(jnp.left_shift(self.q, jnp.int8(4)), jnp.int8(4))
+        hi = jnp.right_shift(self.q, jnp.int8(4))
+        return jnp.stack([lo, hi], axis=-1).reshape(
+            *lead, g, gs, out_packed * 2
+        )
 
 
 class QuantizedTensor4Split(struct.PyTreeNode):
@@ -319,16 +320,29 @@ def matmul(x: jax.Array, w) -> jax.Array:
         return (y * w.full_scale().astype(x.dtype))[..., : w.out_dim]
     if isinstance(w, QuantizedTensor4):
         g, gs, outp = w.q.shape[-3:]
-        # Contract over the bitcast layout DIRECTLY — reshaping the s4 view
-        # to [in, out] first makes XLA materialize it (measured 3x slower at
-        # Llama-7B decode shapes); with the pair axis kept, the bitcast +
-        # convert fuse into the matmul operand read.
-        q4 = jax.lax.bitcast_convert_type(w.q, jnp.int4)  # [..., G, gs, outp, 2]
-        xg = x.reshape(*x.shape[:-1], g, gs)
-        part = jnp.einsum(
-            "...gi,giop->...gop", xg, q4.astype(x.dtype),
-            preferred_element_type=jnp.float32,
-        )
+        # Unpack nibbles ARITHMETICALLY (shift-and-sign-extend), not via
+        # bitcast_convert_type(int4): the int4 bitcast produces a DIFFERENT
+        # nibble interpretation on XLA:TPU than on CPU — measured cos ≈ -0.3
+        # against the fp reference at every width on a real v5e while CPU was
+        # exact (caught by the r4 accuracy harness; the split/Pallas layout
+        # was unaffected, so perf phases never saw it). Two half-matmuls with
+        # the int8->bf16 convert fused into the operand read replace it.
+        lo = jnp.right_shift(jnp.left_shift(w.q, jnp.int8(4)), jnp.int8(4))
+        hi = jnp.right_shift(w.q, jnp.int8(4))  # arithmetic: sign-extends
+        xg = x.reshape(*x.shape[:-1], g, gs).astype(jnp.float32)
+        # f32 operands: full-precision group accumulation (this is the
+        # ACCURACY configuration), and XLA:CPU's dot thunk rejects
+        # bf16 x bf16 -> f32.
+        part = jnp.stack(
+            [
+                jnp.einsum(
+                    "...gi,gio->...go", xg, h.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )
+                for h in (lo, hi)
+            ],
+            axis=-1,
+        )  # [..., G, outp, 2]
         sc = w.scale.reshape(*w.scale.shape[:-1], outp, 2).astype(jnp.float32)
         y = jnp.sum(part * sc, axis=-3)  # reduce groups
         return y.reshape(*y.shape[:-2], outp * 2).astype(x.dtype)

@@ -306,7 +306,9 @@ def quantized_fused_decode_attention(
         bt = min(block_t, t)
         num_blocks = -(-t // bt)
     else:
-        bt = t
+        # 32 always divides t here — a non-multiple-of-32 block_t request
+        # must not silently fall back to a whole-axis tile (VMEM blowup).
+        bt = 32
         for cand in range(min(block_t, t), 31, -32):
             if t % cand == 0:
                 bt = cand
@@ -780,8 +782,9 @@ def sink_fused_decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     # Largest 32-multiple divisor of TR (caches pad TR to a 32 multiple) so
-    # tiles never straddle the buffer end.
-    bt = t
+    # tiles never straddle the buffer end; fall back to 32 (always a
+    # divisor) rather than a whole-axis tile.
+    bt = 32
     for cand in range(min(block_t, t), 31, -32):
         if t % cand == 0:
             bt = cand

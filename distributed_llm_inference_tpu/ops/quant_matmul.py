@@ -1,7 +1,7 @@
 """Pallas int4-weight matmul for bandwidth-bound decode.
 
 Why a kernel: the XLA int4 path (``ops/quant.py:matmul`` on a
-:class:`QuantizedTensor4` — ``bitcast_convert_type`` to ``s4`` + einsum over
+:class:`QuantizedTensor4` — arithmetic nibble unpack + einsum over
 the packed pair axis) reads only the packed half-byte per value from HBM, but
 the pair-axis contraction shape keeps the MXU from tiling it like a plain
 matmul — measured r2: int4 weights LOST to int8 (2,682 vs 3,139 tok/s at

@@ -567,3 +567,70 @@ def test_control_plane_restart_mid_generation(params):
         n2.stop()
         service.stop()
         relay.stop()
+
+
+# -- directory-driven block assignment (r4: server.py:8's "choose optimal
+#    block ids" intent) -------------------------------------------------------
+
+
+def test_assign_policy_gap_then_thinnest():
+    d = BlockDirectory()
+    # Empty deployment: first joiner takes the whole model (default span).
+    assert d.assign(4) == (0, 3)
+    d.register("a", 0, 1, "qa")
+    # Layers 2-3 uncovered: a span-2 joiner gets exactly the hole.
+    assert d.assign(4, span=2) == (2, 3)
+    d.register("b", 2, 3, "qb")
+    # Full coverage: add redundancy where replication is thinnest.
+    d.register("a2", 0, 1, "qa2")  # layers 0-1 now x2
+    assert d.assign(4, span=2) == (2, 3)
+    # A tail gap shorter than span yields a SHORTER range anchored at the
+    # gap (drifting the range backward to use the full span would add
+    # redundancy instead of prioritizing the hole).
+    d2 = BlockDirectory()
+    d2.register("head", 0, 2, "qh")
+    assert d2.assign(4, span=3) == (3, 3)
+    with pytest.raises(ValueError):
+        d.assign(4, span=0)
+
+
+def test_spare_auto_adopts_dead_nodes_range(cluster, params):
+    """Kill one node; a spare started with NO operator-chosen layers asks
+    the directory, adopts the dead range, and serving recovers — the
+    elastic-recovery story without a human in the loop (the r3 version of
+    this test hand-specified the replacement's --layers)."""
+    relay, service, n1, n2 = cluster
+    n2.stop()
+    with DirectoryClient(relay.port) as d:
+        # The lease is already gone (clean stop removes it); the directory
+        # advertises the hole to the next joiner.
+        first, last = d.assign(CFG.num_layers)
+        assert (first, last) == (2, 3)
+    with ServingNode(
+        relay.port, CFG,
+        {k: v[first : last + 1] for k, v in params["layers"].items()},
+        first, last, max_seq_len=64, heartbeat_s=0.5, lease_ttl=3.0,
+        dtype=jnp.float32,
+    ):
+        with DistributedClient(
+            relay.port, CFG, params, prefill_buckets=(16,),
+            dtype=jnp.float32,
+        ) as client:
+            got = client.generate([9, 1, 30], max_new_tokens=4)
+    assert got == _oracle_greedy(params, [9, 1, 30], 4)
+
+
+def test_spare_auto_adopts_after_ttl_crash(cluster, params):
+    """A CRASHED node (no clean removal) re-opens its range when the lease
+    lapses: assign() then hands the hole to a spare."""
+    relay, service, n1, n2 = cluster
+    # Simulate a crash: stop the node's threads WITHOUT removing the lease.
+    # Join the health loop first so no in-flight full-TTL heartbeat can be
+    # applied after the test shortens the lease (a real crash has no
+    # surviving heartbeat thread either).
+    n2._stop.set()
+    n2._health_thread.join(timeout=5)
+    service.directory.heartbeat(n2.node_id, ttl=0.2)  # shorten remaining TTL
+    time.sleep(0.4)
+    with DirectoryClient(relay.port) as d:
+        assert d.assign(CFG.num_layers) == (2, 3)

@@ -1,0 +1,60 @@
+"""Quantization-accuracy harness (tools/quant_accuracy.py) smoke + gate.
+
+The harness itself runs on any checkpoint; CI keeps it honest on a tiny
+random model (metrics well-formed, int8 ~lossless at tiny scale, modes
+ordered sanely) and a REAL-checkpoint run is gated on
+``DLI_ACCURACY_CKPT=<dir-or-url>`` so environments with weights exercise
+the full path.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+from quant_accuracy import SHAPES, run  # noqa: E402
+
+from distributed_llm_inference_tpu.models import llama  # noqa: E402
+
+
+def test_harness_tiny_smoke():
+    cfg = SHAPES["tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    out = run(cfg, params, batch=2, seq=32)
+    for mode in ("int8", "int4", "kv_int8"):
+        m = out[mode]
+        assert 0.0 <= m["top1_agree"] <= 1.0
+        assert m["kl_mean"] >= 0.0
+        assert m["kl_p99"] >= m["kl_mean"] * 0.5  # p99 can't undercut mean
+    # int8 weights must hurt no more than int4 on the same inputs.
+    assert out["int8"]["kl_mean"] <= out["int4"]["kl_mean"] + 1e-6
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DLI_ACCURACY_CKPT"),
+    reason="set DLI_ACCURACY_CKPT=<checkpoint dir or url> to run on real "
+           "weights",
+)
+def test_harness_real_checkpoint():
+    from distributed_llm_inference_tpu.utils import checkpoint
+
+    src = os.environ["DLI_ACCURACY_CKPT"]
+    resolve = None
+    if src.startswith(("http://", "https://")):
+        from distributed_llm_inference_tpu.utils.hub import HttpResolver
+
+        resolve = HttpResolver(src, "/tmp/dli_accuracy_cache")
+    cfg = checkpoint.load_config(src, resolve=resolve)
+    params = checkpoint.load_model_params(
+        src, cfg, jnp.bfloat16, resolve=resolve
+    )
+    out = run(cfg, jax.device_get(params), batch=2, seq=128)
+    # Real-model int8 serving bar: greedy decoding must agree with bf16 on
+    # the overwhelming majority of positions.
+    assert out["int8"]["top1_agree"] > 0.95, out

@@ -1,0 +1,263 @@
+"""Quantization accuracy harness: logit KL / top-1 agreement vs bf16.
+
+The quantized serving modes (int8 / int4 weights, int8 KV) were previously
+evidenced only by tolerance tests on tiny random weights (VERDICT r3 #7);
+this harness measures the distributional damage directly, on ANY local or
+remote checkpoint — or, in environments without one, on a random-init model
+at the real 7B scale (depth/width error accumulation is shape-driven, so
+this is a meaningful upper-bound proxy; it is NOT a substitute for a real
+checkpoint and the output labels it as such).
+
+For each mode the same token batch runs one full forward; the int8-KV mode
+exercises the real cache path (prefill attention reads the quantized KV it
+just wrote). Reported per mode, over the last half of positions (early
+positions have too little context to be representative):
+
+* ``kl_mean`` / ``kl_p99``  — KL(ref || quant) of the next-token
+  distribution, nats;
+* ``top1_agree``            — fraction of positions whose argmax matches
+  the bf16 reference (greedy-decoding agreement);
+* ``top5_overlap``          — mean |top5(ref) ∩ top5(quant)| / 5.
+
+Usage::
+
+    python tools/quant_accuracy.py --model /path/or/http-url   # real ckpt
+    python tools/quant_accuracy.py --shape llama2-7b           # random-init
+    python tools/quant_accuracy.py --shape tiny --batch 2 --seq 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_inference_tpu.cache.dense import (
+    DenseKVCache,
+    QuantizedDenseKVCache,
+)
+from distributed_llm_inference_tpu.config import ModelConfig
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.ops.quant import quantize_params
+
+SHAPES = {
+    "llama2-7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=32, num_heads=32, num_kv_heads=32, head_dim=128,
+        max_position_embeddings=4096,
+    ),
+    # Full 7B width at 8 layers: bf16 + a quantized copy coexist on one
+    # chip, so every mode runs device-side (the 32-layer host path works
+    # but pays slow host<->device transfers per quantize op on tunneled
+    # platforms). Width drives per-layer quantization error; depth drives
+    # accumulation — report the proxy as what it is.
+    "llama2-7b-8l": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=8, num_heads=32, num_kv_heads=32, head_dim=128,
+        max_position_embeddings=4096,
+    ),
+    "tiny": ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=256,
+    ),
+}
+
+
+def _metrics(ref: np.ndarray, quant: np.ndarray) -> dict:
+    """``ref``/``quant``: f32 logits ``[B, S, V]``; stats over the last
+    half of positions."""
+    s = ref.shape[1]
+    ref = ref[:, s // 2:]
+    quant = quant[:, s // 2:]
+    ref = jnp.asarray(ref, jnp.float32)
+    quant = jnp.asarray(quant, jnp.float32)
+    logp = jax.nn.log_softmax(ref, axis=-1)
+    logq = jax.nn.log_softmax(quant, axis=-1)
+    kl = jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)  # [B, S/2]
+    top1 = jnp.argmax(ref, -1) == jnp.argmax(quant, -1)
+    k = min(5, ref.shape[-1])
+    tr = jax.lax.top_k(ref, k)[1]
+    tq = jax.lax.top_k(quant, k)[1]
+    overlap = jnp.mean(
+        jnp.sum(tr[..., :, None] == tq[..., None, :], axis=(-1, -2))
+        / k
+    )
+    kl = np.asarray(kl)
+    return {
+        "kl_mean": round(float(kl.mean()), 6),
+        "kl_p99": round(float(np.percentile(kl, 99)), 6),
+        "top1_agree": round(float(np.asarray(top1).mean()), 4),
+        "top5_overlap": round(float(overlap), 4),
+    }
+
+
+import ml_dtypes
+
+
+def _random_host_params(cfg, seed: int):
+    """Random-init bf16 params as HOST numpy (no device allocation)."""
+    rng = np.random.RandomState(seed)
+    h, d = cfg.hidden_size, cfg.head_dim
+    L, hq, hkv = cfg.num_layers, cfg.num_heads, cfg.num_kv_heads
+    inter = cfg.intermediate_size
+    bf16 = ml_dtypes.bfloat16
+
+    def w(*shape):
+        # f32 generation: float64 randn doubles both time and the transient
+        # footprint at 7B scale (one MLP leaf is 11.5 GB in f64).
+        a = rng.standard_normal(size=shape).astype(np.float32)
+        return (a * np.float32(0.02)).astype(bf16)
+
+    return {
+        "embed": w(cfg.vocab_size, h),
+        "final_norm": np.ones((h,), bf16),
+        "lm_head": w(h, cfg.vocab_size),
+        "layers": {
+            "attn_norm": np.ones((L, h), bf16),
+            "wq": w(L, h, hq * d), "wk": w(L, h, hkv * d),
+            "wv": w(L, h, hkv * d), "wo": w(L, hq * d, h),
+            "mlp_norm": np.ones((L, h), bf16),
+            "wg": w(L, h, inter), "wu": w(L, h, inter),
+            "wd": w(L, inter, h),
+        },
+    }
+
+
+def _load_host_params(model: str):
+    """Checkpoint → HOST-numpy params (+ ``__cfg__``), never touching the
+    device (``load_model_params`` would materialize the bf16 tree there)."""
+    from distributed_llm_inference_tpu.utils import checkpoint
+
+    resolve = None
+    if model.startswith(("http://", "https://")):
+        from distributed_llm_inference_tpu.utils.hub import HttpResolver
+
+        resolve = HttpResolver(model, "/tmp/quant_accuracy_cache")
+    cfg = checkpoint.load_config(model, resolve=resolve)
+    state = checkpoint.block_state_dict(
+        model, None, include_non_layer=True, resolve=resolve
+    )
+    bf16 = ml_dtypes.bfloat16
+    layers = [
+        llama.convert_hf_layer(cfg, state, i, jnp.bfloat16)
+        for i in range(cfg.num_layers)
+    ]
+    params = {
+        "layers": {
+            name: np.stack([lay[name] for lay in layers]).astype(bf16)
+            for name in layers[0]
+        },
+        "embed": np.asarray(
+            state["model.embed_tokens.weight"]
+        ).astype(bf16),
+        "final_norm": np.asarray(state["model.norm.weight"]).astype(bf16),
+        "__cfg__": cfg,
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in state:
+        params["lm_head"] = np.asarray(
+            state["lm_head.weight"]
+        ).T.astype(bf16)
+    return params
+
+
+def _forward(cfg, params, tokens, kv_quant=False):
+    b, s = tokens.shape
+    dtype = jnp.asarray(params["final_norm"]).dtype  # follow the model
+    cls = QuantizedDenseKVCache if kv_quant else DenseKVCache
+    cache = cls.create(
+        cfg.num_layers, b, s, cfg.num_kv_heads, cfg.head_dim, dtype
+    )
+    n = jnp.full((b,), s, jnp.int32)
+    logits, _ = jax.jit(
+        lambda p, t, c: llama.model_apply(cfg, p, t, c, n)
+    )(params, tokens, cache)
+    out = np.asarray(logits, np.float32)
+    del logits
+    return out
+
+
+def run(cfg, params, batch: int, seq: int, seed: int = 0,
+        tokens=None) -> dict:
+    """``params`` may be device or host (numpy) arrays; at 7B scale the
+    bf16 tree and a quantized copy cannot coexist in 16 GB HBM, so the
+    master copy stays ON HOST and each mode materializes alone on device
+    (quantize_params consumes one bf16 leaf at a time)."""
+    if tokens is None:
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(seed), (batch, seq), 0, cfg.vocab_size
+        )
+    nbytes = sum(
+        np.asarray(x).nbytes if not hasattr(x, "nbytes") else x.nbytes
+        for x in jax.tree_util.tree_leaves(params)
+    )
+    if nbytes < 5e9:
+        # Small enough for bf16 + one quantized copy to coexist on device:
+        # everything stays on-chip (no per-op host round trips).
+        dev = jax.tree_util.tree_map(jnp.asarray, params)
+        del params
+        ref = _forward(cfg, dev, tokens)
+        out = {"kv_int8": _metrics(
+            ref, _forward(cfg, dev, tokens, kv_quant=True)
+        )}
+        for name, bits in (("int8", 8), ("int4", 4)):
+            pq = quantize_params(dev, bits=bits)
+            out[name] = _metrics(ref, _forward(cfg, pq, tokens))
+            del pq
+        return out
+    host = jax.tree_util.tree_map(np.asarray, params)
+    del params
+
+    dev = jax.tree_util.tree_map(jnp.asarray, host)
+    ref = _forward(cfg, dev, tokens)
+    out = {"kv_int8": _metrics(
+        ref, _forward(cfg, dev, tokens, kv_quant=True)
+    )}
+    del dev
+    for name, bits in (("int8", 8), ("int4", 4)):
+        pq = quantize_params(host, bits=bits)
+        out[name] = _metrics(ref, _forward(cfg, pq, tokens))
+        del pq
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model", help="checkpoint dir or http(s) URL")
+    src.add_argument("--shape", choices=sorted(SHAPES),
+                     help="random-init at this model shape (proxy only)")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    # The master copy is built ON HOST: at 7B scale the bf16 tree fills
+    # most of HBM and even device_get of a resident tree exhausts the
+    # device (staging buffers on this platform); run() materializes one
+    # mode at a time.
+    if args.model:
+        params = _load_host_params(args.model)
+        cfg = params.pop("__cfg__")
+        source = args.model
+    else:
+        cfg = SHAPES[args.shape]
+        params = _random_host_params(cfg, args.seed)
+        source = f"random-init:{args.shape} (NOT a real checkpoint)"
+
+    out = run(cfg, params, args.batch, args.seq, args.seed)
+    print(json.dumps({
+        "source": source, "batch": args.batch, "seq": args.seq,
+        "backend": jax.default_backend(), **out,
+    }))
+
+
+if __name__ == "__main__":
+    main()
