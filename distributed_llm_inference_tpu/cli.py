@@ -133,7 +133,11 @@ def cmd_serve(args) -> int:
         from .distributed.directory import DirectoryClient
 
         with DirectoryClient(port, host) as d:
-            first, last = d.assign(cfg.num_layers, args.max_layers)
+            # Reserve the range while the (possibly minutes-long) weight
+            # load runs, so concurrent spares spread across holes.
+            first, last = d.assign(
+                cfg.num_layers, args.max_layers, reserve_ttl=600.0
+            )
         print(json.dumps({
             "event": "layers_assigned", "first_layer": first,
             "last_layer": last,

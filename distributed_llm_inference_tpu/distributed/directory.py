@@ -37,6 +37,10 @@ class NodeInfo:
     queue: str  # relay queue the node's worker consumes
     lease_expiry: float = 0.0
     load: int = 0  # active sessions (rebalance hint)
+    # Provisional reservation from assign(): counts toward COVERAGE (the
+    # next joiner is steered elsewhere) but never toward ROUTING (there is
+    # no queue to send to until the node loads its weights and registers).
+    pending: bool = False
 
     def covers(self, layer: int) -> bool:
         return self.first_layer <= layer <= self.last_layer
@@ -86,8 +90,8 @@ class BlockDirectory:
             self._expire_locked()
             return list(self._nodes.values())
 
-    def assign(self, num_layers: int, span: Optional[int] = None
-               ) -> Tuple[int, int]:
+    def assign(self, num_layers: int, span: Optional[int] = None,
+               reserve_ttl: Optional[float] = None) -> Tuple[int, int]:
         """Choose the layer range a JOINING node should serve — the "choose
         optimal block ids" intent the reference sketched and never built
         (``/root/reference/distributed_llm_inference/server/server.py:8``).
@@ -103,7 +107,13 @@ class BlockDirectory:
           replication (add redundancy where the chain is most fragile).
 
         ``span`` (default: whole model) caps how many layers the joining
-        node is willing to hold.
+        node is willing to hold. ``reserve_ttl`` records a PROVISIONAL
+        reservation for the returned range (a pending lease: counted as
+        coverage by later assign() calls, never routed to) so two spares
+        joining concurrently — each spending minutes streaming weights
+        before registering — don't both adopt the same hole while another
+        stays open; the reservation expires on its own if the node never
+        arrives, and the node's real register simply supersedes it.
         """
         if span is not None and span < 1:
             raise ValueError(f"span must be positive, got {span}")
@@ -117,19 +127,30 @@ class BlockDirectory:
             # Start AT the gap (moving the range to fit a full span would
             # drift away from it); a tail gap simply yields a shorter range.
             first = cov.index(0)
-            return first, min(first + span, num_layers) - 1
-        sums = [
-            sum(cov[i : i + span]) for i in range(num_layers - span + 1)
-        ]
-        first = min(range(len(sums)), key=sums.__getitem__)
-        return first, first + span - 1
+            last = min(first + span, num_layers) - 1
+        else:
+            sums = [
+                sum(cov[i : i + span])
+                for i in range(num_layers - span + 1)
+            ]
+            first = min(range(len(sums)), key=sums.__getitem__)
+            last = first + span - 1
+        if reserve_ttl:
+            rid = f"reserved-{uuid.uuid4().hex[:8]}"
+            with self._lock:
+                self._nodes[rid] = NodeInfo(
+                    rid, first, last, queue="",
+                    lease_expiry=time.monotonic() + reserve_ttl,
+                    pending=True,
+                )
+        return first, last
 
     def plan_route(self, num_layers: int) -> List[NodeInfo]:
         """Greedy chain cover of layers ``[0, num_layers)``: at each position
         pick the live node extending coverage furthest (least-loaded on
         ties). Raises if there is a gap — the health signal a client acts on.
         """
-        nodes = self.alive()
+        nodes = [n for n in self.alive() if not n.pending]
         route: List[NodeInfo] = []
         layer = 0
         while layer < num_layers:
@@ -193,7 +214,10 @@ class DirectoryService:
                 d.remove(req["node_id"])
                 return {"ok": True}
             if op == "assign":
-                first, last = d.assign(req["num_layers"], req.get("span"))
+                first, last = d.assign(
+                    req["num_layers"], req.get("span"),
+                    req.get("reserve_ttl"),
+                )
                 return {"ok": True, "first_layer": first, "last_layer": last}
             if op == "route":
                 route = d.plan_route(req["num_layers"])
@@ -206,7 +230,7 @@ class DirectoryService:
                 return {"ok": True, "nodes": [
                     {"node_id": n.node_id, "first_layer": n.first_layer,
                      "last_layer": n.last_layer, "queue": n.queue,
-                     "load": n.load}
+                     "load": n.load, "pending": n.pending}
                     for n in d.alive()
                 ]}
             return {"ok": False, "error": f"unknown op {op!r}"}
@@ -274,12 +298,12 @@ class DirectoryClient:
     def route(self, num_layers: int) -> List[dict]:
         return self._call({"op": "route", "num_layers": num_layers})["route"]
 
-    def assign(self, num_layers: int,
-               span: Optional[int] = None) -> Tuple[int, int]:
+    def assign(self, num_layers: int, span: Optional[int] = None,
+               reserve_ttl: Optional[float] = None) -> Tuple[int, int]:
         """Ask the directory which layer range a joining node should serve
         (see :meth:`BlockDirectory.assign`)."""
         r = self._call({"op": "assign", "num_layers": num_layers,
-                        "span": span})
+                        "span": span, "reserve_ttl": reserve_ttl})
         return r["first_layer"], r["last_layer"]
 
     def alive(self) -> List[dict]:

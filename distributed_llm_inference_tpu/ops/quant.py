@@ -76,6 +76,17 @@ class QuantizedTensor(struct.PyTreeNode):
         return self.scale.dtype
 
 
+def _unpack_nibbles(q: jax.Array):
+    """``(low, high)`` int4-valued int8 halves of a nibble-packed byte via
+    arithmetic shift-and-sign-extend. The ONLY sanctioned unpack:
+    ``lax.bitcast_convert_type`` to int4 reads the nibbles differently on
+    XLA:TPU than on CPU (cos ≈ -0.3 vs the fp reference on a real v5e —
+    caught by tools/quant_accuracy.py in r4)."""
+    lo = jnp.right_shift(jnp.left_shift(q, jnp.int8(4)), jnp.int8(4))
+    hi = jnp.right_shift(q, jnp.int8(4))
+    return lo, hi
+
+
 class QuantizedTensor4(struct.PyTreeNode):
     """int4 weight with per-(input-group, output-channel) scales.
 
@@ -109,8 +120,7 @@ class QuantizedTensor4(struct.PyTreeNode):
         = even channel), via arithmetic shift-and-sign-extend — portable
         across CPU and TPU (the int4 bitcast is not; see class docstring)."""
         *lead, g, gs, out_packed = self.q.shape
-        lo = jnp.right_shift(jnp.left_shift(self.q, jnp.int8(4)), jnp.int8(4))
-        hi = jnp.right_shift(self.q, jnp.int8(4))
+        lo, hi = _unpack_nibbles(self.q)
         return jnp.stack([lo, hi], axis=-1).reshape(
             *lead, g, gs, out_packed * 2
         )
@@ -327,8 +337,7 @@ def matmul(x: jax.Array, w) -> jax.Array:
         # exact (caught by the r4 accuracy harness; the split/Pallas layout
         # was unaffected, so perf phases never saw it). Two half-matmuls with
         # the int8->bf16 convert fused into the operand read replace it.
-        lo = jnp.right_shift(jnp.left_shift(w.q, jnp.int8(4)), jnp.int8(4))
-        hi = jnp.right_shift(w.q, jnp.int8(4))  # arithmetic: sign-extends
+        lo, hi = _unpack_nibbles(w.q)
         xg = x.reshape(*x.shape[:-1], g, gs).astype(jnp.float32)
         # f32 operands: full-precision group accumulation (this is the
         # ACCURACY configuration), and XLA:CPU's dot thunk rejects

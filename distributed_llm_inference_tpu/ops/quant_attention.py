@@ -293,27 +293,22 @@ def quantized_fused_decode_attention(
         scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if t % 32:
-        # On TPU the io-aliased whole-stack operands cannot be padded, so a
-        # time block must DIVIDE t on an int8 sublane boundary; callers
-        # (tail_attend) gate on max_len % 32 == 0 and keep the XLA segments
-        # path for odd buffers. Interpret mode has no tiling constraint —
-        # partial last tiles stay legal for kernel-level unit tests.
-        if not interpret:
-            raise ValueError(
-                f"big-buffer length {t} must be a multiple of 32 on TPU"
-            )
-        bt = min(block_t, t)
-        num_blocks = -(-t // bt)
-    else:
-        # 32 always divides t here — a non-multiple-of-32 block_t request
-        # must not silently fall back to a whole-axis tile (VMEM blowup).
-        bt = 32
-        for cand in range(min(block_t, t), 31, -32):
-            if t % cand == 0:
-                bt = cand
-                break
-        num_blocks = t // bt
+    if t % 32 and not interpret:
+        # The io-aliased whole-stack operands cannot pad on TPU, so the
+        # time axis must sit on an int8 sublane boundary; callers
+        # (tail_attend) gate on max_len % 32 == 0 and keep the XLA
+        # segments path for odd buffers. 32-aligned t keeps the r3 tiling
+        # UNCHANGED — min(block_t, t) blocks with a partial (32-aligned)
+        # last tile, which Mosaic handles and which the perf record is
+        # built on. (An r4 attempt to force bt to a divisor of t regressed
+        # 1k-ctx decode 4.6x — bt=96 tiles — and broke kernels whose
+        # forced bt fell below the 128-lane scale-plane block at other
+        # buffer lengths.)
+        raise ValueError(
+            f"big-buffer length {t} must be a multiple of 32 on TPU"
+        )
+    bt = min(block_t, t)
+    num_blocks = -(-t // bt)
     # The io-aliased tail stacks cannot be batch-padded, so the row block
     # must DIVIDE the batch: largest divisor <= block_b (worst case 1).
     nb = next(n for n in range(min(block_b, b), 0, -1) if b % n == 0)

@@ -634,3 +634,23 @@ def test_spare_auto_adopts_after_ttl_crash(cluster, params):
     time.sleep(0.4)
     with DirectoryClient(relay.port) as d:
         assert d.assign(CFG.num_layers) == (2, 3)
+
+
+def test_assign_reservation_spreads_concurrent_spares():
+    """Two spares joining concurrently (each minutes from registering)
+    must be steered to DIFFERENT holes: assign(reserve_ttl=...) records a
+    pending lease counted as coverage but never routed to."""
+    d = BlockDirectory()
+    d.register("mid", 1, 2, "qm")  # holes at layer 0 and layer 3
+    a = d.assign(4, span=1, reserve_ttl=5.0)
+    b = d.assign(4, span=1, reserve_ttl=5.0)
+    assert {a, b} == {(0, 0), (3, 3)}
+    # Reservations cover layers for assign() but are NOT routable.
+    with pytest.raises(LookupError):
+        d.plan_route(4)
+    # An expired reservation re-opens its hole.
+    d2 = BlockDirectory()
+    d2.register("mid", 1, 3, "qm")
+    assert d2.assign(4, span=1, reserve_ttl=0.01) == (0, 0)
+    time.sleep(0.05)
+    assert d2.assign(4, span=1) == (0, 0)

@@ -37,6 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 from distributed_llm_inference_tpu.cache.dense import (
@@ -97,9 +98,6 @@ def _metrics(ref: np.ndarray, quant: np.ndarray) -> dict:
         "top1_agree": round(float(np.asarray(top1).mean()), 4),
         "top5_overlap": round(float(overlap), 4),
     }
-
-
-import ml_dtypes
 
 
 def _random_host_params(cfg, seed: int):
