@@ -146,11 +146,19 @@ def cmd_serve(args) -> int:
         args.model, cfg, list(range(first, last + 1)),
         jnp.dtype(args.dtype), resolve=resolve, cache_dir=args.weights_cache,
     )
+    from .config import CacheConfig, MeshConfig
+
+    mesh_cfg = MeshConfig(tp=args.tp) if args.tp > 1 else None
+    cache_cfg = CacheConfig(
+        kind=args.cache, kv_quant=args.kv_quant,
+        window_length=args.sink_window, num_sink_tokens=args.sink_tokens,
+        page_size=args.page_size, num_pages=args.num_pages,
+    )
     node = ServingNode(
         port, cfg, params["layers"], first, last, host=host,
         node_id=args.node_id, max_sessions=args.max_sessions,
         max_seq_len=args.max_seq_len, dtype=jnp.dtype(args.dtype),
-        quantize=args.quantize, kv_quant=args.kv_quant,
+        quantize=args.quantize, cache_cfg=cache_cfg, mesh_cfg=mesh_cfg,
     )
     print(json.dumps({
         "event": "node_up", "node_id": node.node_id, "queue": node.queue,
@@ -334,6 +342,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve this block with quantized weights")
     s.add_argument("--kv-quant", default=None, choices=("int8",),
                    help="store this node's KV cache int8")
+    s.add_argument("--cache", default="dense",
+                   choices=("dense", "sink", "paged"),
+                   help="this node's KV storage: dense growth-ladder, "
+                        "StreamingLLM sink ring (unbounded streams, fixed "
+                        "memory), or vLLM-style paged pool")
+    s.add_argument("--sink-window", type=int, default=1024,
+                   help="sink ring length (--cache sink)")
+    s.add_argument("--sink-tokens", type=int, default=4,
+                   help="always-kept sink tokens (--cache sink)")
+    s.add_argument("--page-size", type=int, default=64,
+                   help="tokens per page (--cache paged)")
+    s.add_argument("--num-pages", type=int, default=512,
+                   help="page pool size (--cache paged)")
+    s.add_argument("--tp", type=int, default=1,
+                   help="shard this node's block over N local chips "
+                        "(tensor parallel within the node; the relay "
+                        "protocol is unchanged)")
     s.set_defaults(fn=cmd_serve)
 
     g = sub.add_parser("generate", help="generate through registered nodes")

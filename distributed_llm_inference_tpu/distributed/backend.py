@@ -8,6 +8,23 @@ multi-tenant sessions keyed by ``generation_id``
 (``models/llama/cache.py:14-19``) mapped onto batch rows of one preallocated
 cache. All device computation is cached ``jax.jit`` executables — the role
 CUDA-graph capture plays in the reference (``utils/cuda.py:6``).
+
+Two axes the reference prescribed but never composed are first-class here:
+
+* **Cache kind** — the reference's sink cache is literally titled
+  "Distributed implementation of sink cache"
+  (``models/llama/cache.py:8-10``): its signature bounded-memory policy
+  exists *for served blocks*. ``cache_cfg`` selects dense (growth-ladder),
+  sink (StreamingLLM ring: unbounded streams, fixed memory) or paged
+  (vLLM-style pool: page-granular growth) storage for this node's sessions,
+  each optionally int8.
+* **Local mesh** — the reference's worker serves
+  ``block_index_start..end`` on whatever hardware the node has
+  (``server/worker.py:13-14``). On a multi-chip host that means tensor
+  parallelism *within* the node: ``mesh_cfg=MeshConfig(tp=N)`` shards the
+  block's weights and KV over the host's chips with XLA inserting the ICI
+  all-reduces, while the relay protocol (and every peer) is unchanged —
+  the two-tier design of SURVEY §5.8 composed at last.
 """
 
 from __future__ import annotations
@@ -21,7 +38,9 @@ import numpy as np
 
 from ..cache.base import window_ladder
 from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
-from ..config import ModelConfig
+from ..cache.paged import PageAllocator, PagedKVCache, QuantizedPagedKVCache
+from ..cache.sink import QuantizedSinkKVCache, SinkKVCache
+from ..config import CacheConfig, MeshConfig, ModelConfig
 from ..models import llama
 
 __all__ = ["BlockBackend", "SchemaError"]
@@ -47,43 +66,129 @@ class BlockBackend:
         session_idle_timeout: float = 60.0,
         quantize: Optional[str] = None,
         kv_quant: Optional[str] = None,
+        cache_cfg: Optional[CacheConfig] = None,
+        mesh_cfg: Optional[MeshConfig] = None,
     ):
         """``quantize`` ("int8"/"int4") serves the block with quantized
         weights — the deployment-facing optimization the reference applied
         on its serving node (bitsandbytes ``Linear8bitLt`` swap,
         ``/root/reference/distributed_llm_inference/utils/model.py:93-123``);
-        ``kv_quant="int8"`` additionally stores this node's KV cache int8."""
+        ``kv_quant="int8"`` additionally stores this node's KV cache int8.
+
+        ``cache_cfg`` selects the cache *kind* (dense/sink/paged — see the
+        module docstring); omitted it is the dense growth-ladder cache, with
+        ``kv_quant`` as shorthand for its int8 variant. ``mesh_cfg`` shards
+        the node over its local chips (tp only — the cross-host axes are the
+        relay's job, one node per stage)."""
         self.session_idle_timeout = session_idle_timeout
         self.cfg = cfg
+        self.mesh = None
+        self._shard_cache_fn = None
+        tp = 1
+        if mesh_cfg is not None:
+            if (mesh_cfg.dp, mesh_cfg.pp, mesh_cfg.sp, mesh_cfg.ep) != (
+                1, 1, 1, 1,
+            ):
+                raise ValueError(
+                    "a block node shards over tp only (dp/pp/sp/ep are the "
+                    f"relay tier's axes — one node per stage); got {mesh_cfg}"
+                )
+            tp = mesh_cfg.tp
+            if cfg.num_kv_heads % tp != 0:
+                raise ValueError(
+                    f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads}"
+                )
+            if cfg.intermediate_size % tp != 0:
+                raise ValueError(
+                    f"tp={tp} must divide intermediate_size="
+                    f"{cfg.intermediate_size}"
+                )
         if quantize in ("int8", "int4"):
             from ..ops.quant import quantize_params
 
+            qkw = {}
+            if quantize == "int4" and tp > 1:
+                # The half-split packed layout interleaves channels within a
+                # byte column and cannot column-shard; tp nodes keep the
+                # grouped XLA layout with whole groups per device (the same
+                # rule the engine applies under tp/pp meshes).
+                qkw = {"int4_layout": "grouped", "group_multiple": tp}
             layer_params = quantize_params(
-                layer_params, bits=4 if quantize == "int4" else 8
+                layer_params, bits=4 if quantize == "int4" else 8, **qkw
             )
         elif quantize is not None:
             raise ValueError(f"unknown quantize {quantize!r}")
         if kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv_quant {kv_quant!r}")
+        if cache_cfg is None:
+            cache_cfg = CacheConfig(kind="dense", kv_quant=kv_quant)
+        elif kv_quant is not None and kv_quant != cache_cfg.kv_quant:
+            raise ValueError(
+                f"kv_quant={kv_quant!r} conflicts with "
+                f"cache_cfg.kv_quant={cache_cfg.kv_quant!r}"
+            )
+        if cache_cfg.kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown kv_quant {cache_cfg.kv_quant!r}")
+        self.ccfg = cache_cfg
         self.params = layer_params
         self.first_layer, self.last_layer = first_layer, last_layer
         self.num_block_layers = last_layer - first_layer + 1
         self.max_sessions = max_sessions
         self.max_seq_len = max_seq_len
         self.dtype = jnp.dtype(dtype)
-        self._cache_cls = (
-            QuantizedDenseKVCache if kv_quant == "int8" else DenseKVCache
-        )
 
-        # Growth ladder (shared with the engine): the buffer starts at the
-        # smallest bucket and zero-pad-grows as resident sessions lengthen,
-        # so decode bandwidth tracks LIVE context; max_seq_len is the
-        # virtual cap.
-        self._windows = window_ladder(max_seq_len)
-        self.cache = self._cache_cls.create(
-            self.num_block_layers, max_sessions, self._windows[0],
-            cfg.num_kv_heads, cfg.head_dim, dtype,
-        )
+        cc = cache_cfg
+        L, B = self.num_block_layers, max_sessions
+        q8 = cc.kv_quant == "int8"
+        self.allocator: Optional[PageAllocator] = None
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._windows: Tuple[int, ...] = ()
+        if cc.kind == "dense":
+            cls = QuantizedDenseKVCache if q8 else DenseKVCache
+            # Growth ladder (shared with the engine): the buffer starts at
+            # the smallest bucket and zero-pad-grows as resident sessions
+            # lengthen, so decode bandwidth tracks LIVE context; max_seq_len
+            # is the virtual cap.
+            self._windows = window_ladder(max_seq_len)
+            self._make_cache = lambda w: cls.create(
+                L, B, w, cfg.num_kv_heads, cfg.head_dim, dtype
+            )
+            self.cache = self._make_cache(self._windows[0])
+        elif cc.kind == "sink":
+            # StreamingLLM ring: fixed memory, unbounded streams —
+            # max_seq_len does not cap sink sessions.
+            cls = QuantizedSinkKVCache if q8 else SinkKVCache
+            kw = {"use_kernel": False} if q8 else {}
+            self.cache = cls.create(
+                L, B, cc.window_length, cc.num_sink_tokens,
+                cfg.num_kv_heads, cfg.head_dim, dtype, **kw,
+            )
+        elif cc.kind == "paged":
+            slots = max(1, -(-max_seq_len // cc.page_size))
+            cls = QuantizedPagedKVCache if q8 else PagedKVCache
+            self.cache = cls.create(
+                L, B, cc.num_pages, cc.page_size, slots,
+                cfg.num_kv_heads, cfg.head_dim, dtype,
+            )
+            self.allocator = PageAllocator(cc.num_pages)
+        else:
+            raise ValueError(f"unknown cache kind {cc.kind!r}")
+
+        if tp > 1:
+            from ..parallel import (
+                build_mesh, cache_pspecs, param_pspecs, shard_pytree,
+            )
+
+            self.mesh = build_mesh(mesh_cfg)
+            self.params = shard_pytree(
+                self.params, self.mesh,
+                param_pspecs({"layers": self.params})["layers"],
+            )
+            self._shard_cache_fn = lambda c: shard_pytree(
+                c, self.mesh, cache_pspecs(c)
+            )
+            self.cache = self._shard_cache_fn(self.cache)
+
         # generation_id → (slot row, last-touch time); free slots LRU-reused.
         self.sessions: Dict[str, Tuple[int, float]] = {}
         # Host-side per-slot lengths (avoids a device sync per hop).
@@ -95,7 +200,7 @@ class BlockBackend:
             sub = sub.advance(n_valid[None])
             return y, cache.merge_row(sub, row)
 
-        self._row_step = jax.jit(_row_step, donate_argnums=(2,))
+        self._row_step = self._in_mesh(jax.jit(_row_step, donate_argnums=(2,)))
 
         # Batched step over ALL session rows at once (rows with num_new=0 are
         # masked): N concurrent hops become one device call. Single hops keep
@@ -105,24 +210,45 @@ class BlockBackend:
             y, cache = llama.block_apply(self.cfg, params, x, cache, num_new)
             return y, cache.advance(num_new)
 
-        self._batch_step = jax.jit(_batch_step, donate_argnums=(2,))
+        self._batch_step = self._in_mesh(
+            jax.jit(_batch_step, donate_argnums=(2,))
+        )
         # Observability (tests assert batching actually happens).
         self.batched_calls = 0
         self.batched_items = 0
 
         # Output schema inferred by a dummy forward (the reference's
         # ``backend.py:31-35`` pattern): hidden-in → hidden-out, same shape.
+        # The probe always runs on a throwaway dense cache — the schema
+        # depends only on the hidden size, not the serving cache kind.
         probe = jnp.zeros((1, 1, cfg.hidden_size), dtype)
         y, _ = self._row_step(
             self.params, probe,
-            self._cache_cls.create(self.num_block_layers, 1, 8,
-                                   cfg.num_kv_heads, cfg.head_dim, dtype),
+            DenseKVCache.create(self.num_block_layers, 1, 8,
+                                cfg.num_kv_heads, cfg.head_dim, dtype),
             jnp.int32(0), jnp.int32(1),
         )
         self.output_schema = {"shape_suffix": (cfg.hidden_size,),
                               "dtype": str(y.dtype)}
 
+    def _in_mesh(self, fn):
+        """Run a jitted step inside the mesh context when serving sharded."""
+        if self.mesh is None:
+            return fn
+        mesh = self.mesh
+
+        def wrapped(*a, **kw):
+            with mesh:
+                return fn(*a, **kw)
+
+        return wrapped
+
     # -- session management ---------------------------------------------------
+
+    def _free_slot_pages(self, slot: int) -> None:
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self.allocator.free(pages)
 
     def _slot_for(self, generation_id: str, create: bool) -> int:
         if generation_id in self.sessions:
@@ -154,21 +280,28 @@ class BlockBackend:
                 )
             lru = min(idle, key=lambda g: self.sessions[g][1])
             slot = self.sessions.pop(lru)[0]
-        if not self.sessions and self.cache.max_len > self._windows[0]:
+        if (
+            self._windows
+            and not self.sessions
+            and self.cache.max_len > self._windows[0]
+        ):
             # Nothing resident: drop back to the smallest bucket (no copy).
-            self.cache = self._cache_cls.create(
-                self.num_block_layers, self.max_sessions, self._windows[0],
-                self.cfg.num_kv_heads, self.cfg.head_dim, self.dtype,
-            )
+            self.cache = self._make_cache(self._windows[0])
+            if self._shard_cache_fn is not None:
+                self.cache = self._shard_cache_fn(self.cache)
         self.sessions[generation_id] = (slot, time.monotonic())
         self._slot_len[slot] = 0
+        if self.allocator is not None:
+            self._free_slot_pages(slot)
         self.cache = self.cache.reset_rows(
             np.arange(self.max_sessions) == slot
         )
         return slot
 
     def end(self, generation_id: str) -> None:
-        self.sessions.pop(generation_id, None)
+        entry = self.sessions.pop(generation_id, None)
+        if entry is not None and self.allocator is not None:
+            self._free_slot_pages(entry[0])
 
     @property
     def load(self) -> int:
@@ -185,6 +318,51 @@ class BlockBackend:
             )
         if not (0 < num_new <= x.shape[1]):
             raise SchemaError(f"num_new {num_new} outside (0, {x.shape[1]}]")
+
+    def _check_capacity(self, needed: int, num_new: int) -> None:
+        """Per-kind session-length policy. Dense/paged cap at max_seq_len;
+        sink streams are unbounded (the ring's fixed memory IS the policy)
+        but a single chunk must fit the ring span."""
+        if self.ccfg.kind == "sink":
+            span = self.cache.window - self.cache.num_sinks
+            if num_new > span:
+                raise SchemaError(
+                    f"chunk of {num_new} exceeds the sink ring span {span}"
+                )
+            return
+        if needed > self.max_seq_len:
+            raise SchemaError(
+                f"session exceeds max_seq_len={self.max_seq_len}"
+            )
+
+    def _ensure_pages(self, installs, resolved, items, results):
+        """Paged kind: map enough pool pages for every resolved hop BEFORE
+        the device step (the scheduler half of ``PagedKVCache.fits``).
+        Collected installs go to the device in ONE batched scatter.
+
+        Pool pressure fails only the STARVED item (node_full-class error the
+        client retries elsewhere), never its co-batched neighbours; a fresh
+        admission that could not get pages is rolled back so it does not
+        occupy a slot with an unusable empty session."""
+        ok = []
+        for item in resolved:
+            i, slot, _, _, needed = item
+            have = self._slot_pages.setdefault(slot, [])
+            want = -(-needed // self.ccfg.page_size)
+            if want > len(have):
+                try:
+                    fresh = self.allocator.alloc(want - len(have))
+                except MemoryError as e:
+                    results[i] = RuntimeError(f"node full: {e}")
+                    if self._slot_len.get(slot, 0) == 0:
+                        self.sessions.pop(items[i][0], None)
+                        self._free_slot_pages(slot)
+                    continue
+                for j, page in enumerate(fresh):
+                    installs.append((slot, len(have) + j, page))
+                have.extend(fresh)
+            ok.append(item)
+        return ok
 
     def forward(
         self, generation_id: str, x, num_new: int, create: bool = False
@@ -221,21 +399,32 @@ class BlockBackend:
                     deferred.append(i)
                     continue
                 needed = self._slot_len.get(slot, 0) + num_new
-                if needed > self.max_seq_len:
-                    raise SchemaError(
-                        f"session exceeds max_seq_len={self.max_seq_len}"
-                    )
+                self._check_capacity(needed, num_new)
                 taken.add(slot)
                 resolved.append((i, slot, xa, num_new, needed))
             except Exception as e:
                 results[i] = e
 
         if resolved:
-            need_max = max(n for *_, n in resolved)
-            if need_max > self.cache.max_len:
-                self.cache = self.cache.grow_to(
-                    next(w for w in self._windows if w >= need_max)
-                )
+            if self._windows:
+                need_max = max(n for *_, n in resolved)
+                if need_max > self.cache.max_len:
+                    self.cache = self.cache.grow_to(
+                        next(w for w in self._windows if w >= need_max)
+                    )
+                    if self._shard_cache_fn is not None:
+                        self.cache = self._shard_cache_fn(self.cache)
+            if self.allocator is not None:
+                installs: List[Tuple[int, int, int]] = []
+                resolved = self._ensure_pages(installs, resolved, items,
+                                              results)
+                if installs:
+                    self.cache = self.cache.assign_pages_batch(
+                        [r for r, _, _ in installs],
+                        [s for _, s, _ in installs],
+                        [p for _, _, p in installs],
+                    )
+        if resolved:
             if len(resolved) == 1:
                 i, slot, xa, num_new, needed = resolved[0]
                 y, self.cache = self._row_step(

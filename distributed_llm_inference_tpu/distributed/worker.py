@@ -67,6 +67,9 @@ class ServingNode:
         batch_window_s: float = 0.002,
         quantize=None,
         kv_quant=None,
+        cache_cfg=None,
+        mesh_cfg=None,
+        pool_max_batch: Optional[int] = None,
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.queue = f"block.{self.node_id}"
@@ -75,7 +78,8 @@ class ServingNode:
         kw = {} if dtype is None else {"dtype": dtype}
         self.backend = BlockBackend(
             cfg, layer_params, first_layer, last_layer, max_sessions,
-            max_seq_len, quantize=quantize, kv_quant=kv_quant, **kw,
+            max_seq_len, quantize=quantize, kv_quant=kv_quant,
+            cache_cfg=cache_cfg, mesh_cfg=mesh_cfg, **kw,
         )
         self._stop = threading.Event()
         self.errors: List[str] = []
@@ -99,8 +103,11 @@ class ServingNode:
             self._directory.close()
             raise
         try:
+            # ``pool_max_batch`` exists for A/B measurement (bench.py's
+            # distributed phase): 1 disables co-batching so the batching
+            # win is quantifiable; serving keeps the default.
             self._pool = TaskPool(
-                self._process_batch, max_batch=max_sessions,
+                self._process_batch, max_batch=pool_max_batch or max_sessions,
                 window_s=batch_window_s, signature=lambda item: item[0],
                 name=f"{self.node_id}.pool",
             )

@@ -248,9 +248,15 @@ class InferenceEngine:
                         "sp>1 ring prefill requires a dense cache kind (it "
                         f"ingests contiguous ring KV; got kind={cc.kind!r})"
                     )
-            if mesh_cfg.pp > 1 and cc.kind != "dense":
+            if mesh_cfg.pp > 1 and cc.kind not in ("dense", "paged"):
+                # Paged composes: the pool's layer axis leads every array, so
+                # pp stages hold their own layers' pages (pipeline's
+                # SHARED_FIELDS path); page-table installs already dispatch
+                # the GSPMD-safe chunked DUS route under any mesh. The sink
+                # ring's fused write-behind tail has no staged variant.
                 raise ValueError(
-                    f"pp>1 serving requires the dense cache (got {cc.kind!r})"
+                    f"pp>1 serving requires the dense or paged cache "
+                    f"(got {cc.kind!r})"
                 )
             if self.batch % (mesh_cfg.pp * mesh_cfg.dp) != 0:
                 raise ValueError(

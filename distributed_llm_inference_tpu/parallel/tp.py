@@ -140,8 +140,8 @@ def cache_pspecs(cache: Any, use_pp: bool = False) -> Any:
     over ``dp``; the layer axis over ``pp`` when pipelining.
     """
     from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
-    from ..cache.paged import PagedKVCache
-    from ..cache.sink import SinkKVCache
+    from ..cache.paged import PagedKVCache, QuantizedPagedKVCache
+    from ..cache.sink import QuantizedSinkKVCache, SinkKVCache
 
     pp = "pp" if use_pp else None
     if isinstance(cache, QuantizedDenseKVCache):
@@ -155,11 +155,30 @@ def cache_pspecs(cache: Any, use_pp: bool = False) -> Any:
     if isinstance(cache, DenseKVCache):
         kv = P(pp, "dp", None, "tp", None)
         return DenseKVCache(k=kv, v=kv, lengths=P("dp"))
+    if isinstance(cache, QuantizedPagedKVCache):
+        # Pool layout [L, P, Hkv, PS, D] + scale planes [L, P, Hkv, PS]:
+        # kv heads over tp, pages replicated (any row may read any page).
+        kv = P(pp, None, "tp", None, None)
+        sc = P(pp, None, "tp", None)
+        return QuantizedPagedKVCache(
+            k_pages=kv, v_pages=kv, ks_pages=sc, vs_pages=sc,
+            page_table=P("dp", None), lengths=P("dp"),
+            page_size=cache.page_size, use_kernel=cache.use_kernel,
+        )
     if isinstance(cache, PagedKVCache):
         kv = P(pp, None, "tp", None, None)
         return PagedKVCache(
             k_pages=kv, v_pages=kv, page_table=P("dp", None), lengths=P("dp"),
             page_size=cache.page_size, use_kernel=cache.use_kernel,
+        )
+    if isinstance(cache, QuantizedSinkKVCache):
+        # Head-major ring + sink planes: kv heads (axis 2) over tp.
+        kv = P(pp, "dp", "tp", None, None)
+        sc = P(pp, "dp", "tp", None)
+        return QuantizedSinkKVCache(
+            k=kv, v=kv, ks=sc, vs=sc, sk=kv, sv=kv, sks=sc, svs=sc,
+            lengths=P("dp"), num_sinks=cache.num_sinks,
+            ring_slots=cache.ring_slots, use_kernel=cache.use_kernel,
         )
     if isinstance(cache, SinkKVCache):
         kv = P(pp, "dp", None, "tp", None)

@@ -654,3 +654,182 @@ def test_assign_reservation_spreads_concurrent_spares():
     assert d2.assign(4, span=1, reserve_ttl=0.01) == (0, 0)
     time.sleep(0.05)
     assert d2.assign(4, span=1) == (0, 0)
+
+
+# -- cache kinds + local tp behind the relay (SURVEY §5.8 two-tier compose) --
+
+
+def test_tp_sharded_nodes_match_oracle(params):
+    """Two relay nodes, each tp=2 over local (virtual) chips: the block's
+    weights and KV shard over the node's mesh with XLA inserting the
+    all-reduces, while the relay protocol — and the client — are unchanged.
+    The reference's worker intent (serve ``block_index_start..end`` on
+    whatever hardware the node has, ``server/worker.py:13-14``) on a
+    multi-chip host."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=3.0):
+            with ServingNode(
+                relay.port, CFG,
+                {k: v[0:2] for k, v in params["layers"].items()}, 0, 1,
+                max_seq_len=64, heartbeat_s=0.5, lease_ttl=3.0,
+                dtype=jnp.float32, mesh_cfg=MeshConfig(tp=2),
+            ) as n1, ServingNode(
+                relay.port, CFG,
+                {k: v[2:4] for k, v in params["layers"].items()}, 2, 3,
+                max_seq_len=64, heartbeat_s=0.5, lease_ttl=3.0,
+                dtype=jnp.float32, mesh_cfg=MeshConfig(tp=2),
+            ) as n2:
+                assert n1.backend.mesh is not None
+                assert n2.backend.mesh is not None
+                # The sharding is real: a weight leaf lives on 2 devices.
+                wq = n1.backend.params["wq"]
+                assert len(wq.sharding.device_set) == 2
+                with DistributedClient(
+                    relay.port, CFG, params, prefill_buckets=(16,),
+                    dtype=jnp.float32,
+                ) as client:
+                    got = client.generate([5, 11, 42], max_new_tokens=6)
+    assert got == _oracle_greedy(params, [5, 11, 42], 6)
+
+
+def test_tp_sharded_node_rejects_cross_host_axes(params):
+    from distributed_llm_inference_tpu.config import MeshConfig
+    from distributed_llm_inference_tpu.distributed.backend import BlockBackend
+
+    with pytest.raises(ValueError, match="tp only"):
+        BlockBackend(
+            CFG, {k: v[0:2] for k, v in params["layers"].items()}, 0, 1,
+            dtype=jnp.float32, mesh_cfg=MeshConfig(pp=2),
+        )
+
+
+def _oracle_greedy_sink(params, prompt, steps, window, sinks):
+    from distributed_llm_inference_tpu.cache.sink import SinkKVCache
+
+    cache = SinkKVCache.create(
+        CFG.num_layers, 1, window, sinks, CFG.num_kv_heads, CFG.head_dim,
+        jnp.float32,
+    )
+    tokens = jnp.asarray([prompt], jnp.int32)
+    logits, cache = llama.model_apply(
+        CFG, params, tokens, cache, jnp.full((1,), len(prompt), jnp.int32)
+    )
+    tok = int(jnp.argmax(logits[0, len(prompt) - 1]))
+    out = [tok]
+    for _ in range(steps - 1):
+        logits, cache = llama.model_apply(
+            CFG, params, jnp.asarray([[tok]], jnp.int32), cache,
+            jnp.ones((1,), jnp.int32),
+        )
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+    return out
+
+
+def test_sink_node_streams_past_window(params):
+    """A relay node serving its block with the SINK cache decodes a stream
+    LONGER than its window — the reference's headline bounded-memory feature
+    ("Distributed implementation of sink cache",
+    ``models/llama/cache.py:8-10``) in the reference's own distributed
+    setting. Output matches a single-process sink-cache oracle exactly."""
+    from distributed_llm_inference_tpu.config import CacheConfig
+
+    window, sinks, steps = 24, 4, 40
+    cc = CacheConfig(kind="sink", window_length=window, num_sink_tokens=sinks)
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=3.0):
+            with ServingNode(
+                relay.port, CFG, params["layers"], 0, CFG.num_layers - 1,
+                max_seq_len=32,  # sink streams are NOT capped by this
+                heartbeat_s=0.5, lease_ttl=3.0, dtype=jnp.float32,
+                cache_cfg=cc,
+            ):
+                with DistributedClient(
+                    relay.port, CFG, params, prefill_buckets=(16,),
+                    dtype=jnp.float32,
+                ) as client:
+                    got = client.generate([5, 11, 42], max_new_tokens=steps)
+    assert len(got) == steps  # well past window=24: memory stayed fixed
+    assert got == _oracle_greedy_sink(params, [5, 11, 42], steps, window,
+                                      sinks)
+
+
+def test_paged_node_growth_matches_dense(params):
+    """A paged-pool node grows sessions page-by-page (allocator + batched
+    table installs) and its outputs match the dense backend bit-for-bit."""
+    from distributed_llm_inference_tpu.config import CacheConfig
+    from distributed_llm_inference_tpu.distributed.backend import BlockBackend
+
+    block = {k: v[0:2] for k, v in params["layers"].items()}
+    paged = BlockBackend(
+        CFG, block, 0, 1, max_sessions=2, max_seq_len=64, dtype=jnp.float32,
+        cache_cfg=CacheConfig(kind="paged", page_size=8, num_pages=32),
+    )
+    dense = BlockBackend(
+        CFG, block, 0, 1, max_sessions=2, max_seq_len=64, dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal((1, 16, CFG.hidden_size)).astype(np.float32)
+    yp = paged.forward("g", x0, 12, create=True)
+    yd = dense.forward("g", x0, 12, create=True)
+    np.testing.assert_allclose(yp[:, :12], yd[:, :12], rtol=2e-5, atol=2e-5)
+    for i in range(10):
+        x = rng.standard_normal((1, 1, CFG.hidden_size)).astype(np.float32)
+        yp = paged.forward("g", x, 1)
+        yd = dense.forward("g", x, 1)
+        np.testing.assert_allclose(yp, yd, rtol=2e-4, atol=2e-4)
+    # 12 + 10 = 22 tokens at page_size=8 → the session grew to 3 pages.
+    slot = paged.sessions["g"][0]
+    assert len(paged._slot_pages[slot]) == 3
+    # Ending the session returns its pages to the pool.
+    free_before = paged.allocator.free_count
+    paged.end("g")
+    assert paged.allocator.free_count == free_before + 3
+
+
+def test_paged_node_pool_exhaustion_fails_cleanly(params):
+    """Pool pressure on a paged node fails the REQUEST (node_full-class error
+    the client can retry elsewhere), never the node."""
+    from distributed_llm_inference_tpu.config import CacheConfig
+    from distributed_llm_inference_tpu.distributed.backend import BlockBackend
+
+    backend = BlockBackend(
+        CFG, {k: v[0:2] for k, v in params["layers"].items()}, 0, 1,
+        max_sessions=4, max_seq_len=64, dtype=jnp.float32,
+        cache_cfg=CacheConfig(kind="paged", page_size=8, num_pages=6),
+    )
+    x = np.zeros((1, 16, CFG.hidden_size), np.float32)
+    backend.forward("a", x, 16, create=True)  # 2 of the 5 usable pages
+    backend.forward("b", x, 16, create=True)  # 2 more
+    with pytest.raises(RuntimeError, match="node full"):
+        backend.forward("c", x, 16, create=True)  # needs 2, only 1 left
+    # The starved admission was rolled back — no empty session squats a slot.
+    assert "c" not in backend.sessions
+    # Live sessions are unaffected, and the remaining page still serves
+    # session a's growth past its page boundary (16 → 17 tokens).
+    y1 = backend.forward("a", np.ones((1, 1, CFG.hidden_size), np.float32), 1)
+    assert np.isfinite(np.asarray(y1)).all()
+
+
+def test_sink_node_tp_composes(params):
+    """Cache kind × local mesh compose: a tp=2 node serving the sink ring."""
+    from distributed_llm_inference_tpu.config import CacheConfig, MeshConfig
+    from distributed_llm_inference_tpu.distributed.backend import BlockBackend
+
+    backend = BlockBackend(
+        CFG, params["layers"], 0, CFG.num_layers - 1, max_sessions=2,
+        dtype=jnp.float32,
+        cache_cfg=CacheConfig(kind="sink", window_length=24,
+                              num_sink_tokens=4),
+        mesh_cfg=MeshConfig(tp=2),
+    )
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 8, CFG.hidden_size)).astype(np.float32)
+    y = backend.forward("g", x, 8, create=True)
+    for _ in range(30):  # stream past the 24-token window
+        y = backend.forward(
+            "g", rng.standard_normal((1, 1, CFG.hidden_size)
+                                     ).astype(np.float32), 1)
+    assert np.isfinite(np.asarray(y)).all()

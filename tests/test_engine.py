@@ -746,3 +746,34 @@ def test_batched_admission_padded_group_preserves_every_row():
         assert single == batched, (kind, single, batched)
         assert counters.get("batched_prefills", 0) == 3, counters
 
+
+
+@pytest.mark.parametrize("mesh_kw,kv_quant", [
+    (dict(pp=2), None),
+    (dict(pp=2, dp=2), None),
+    (dict(pp=2, tp=2), None),
+    (dict(pp=2), "int8"),
+])
+def test_engine_pp_paged_matches_solo(mesh_kw, kv_quant):
+    """BASELINE configs 4+5 composed (VERDICT r4 ask 9): the vLLM-style
+    paged pool serves under a pipeline-parallel mesh. The pool's layer axis
+    leads every array, so each pp stage holds its own layers' pages
+    (pipeline SHARED_FIELDS pass-through); page installs ride the chunked
+    GSPMD-safe DUS path. Tokens match the solo paged engine exactly."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    ps = prompts(6, seed=17)
+    opts = SamplingOptions(max_new_tokens=6)
+    kw = dict(
+        max_batch_size=4, prefill_buckets=(8, 16, 32), max_seq_len=64,
+        dtype="float32",
+    )
+    cc = CacheConfig(kind="paged", kv_quant=kv_quant, page_size=8,
+                     num_pages=64, max_pages_per_session=8)
+    plain = InferenceEngine(
+        CFG, PARAMS, EngineConfig(**kw), cc,
+    ).generate(ps, opts)
+    eng = InferenceEngine(
+        CFG, PARAMS, EngineConfig(**kw), cc, mesh_cfg=MeshConfig(**mesh_kw),
+    )
+    assert eng.generate(ps, opts) == plain
