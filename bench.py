@@ -673,13 +673,74 @@ def _engine_decode_bench(cfg, params, batch, prompt_len, ticks=4,
     )
 
 
-def _spec_engine_bench(cfg, dcfg, params, dparams, batch, prompt_len,
-                       ticks=6, spec_k=4):
+def _cycle_len(c) -> int:
+    """Transition-cycle length shared by the param builder and the
+    bench's prompt sampler — prompts MUST stay on the cycle (an off-cycle
+    token hits an all-zero lm_head row and degenerates the walk)."""
+    return min(4096, c.hidden_size, c.vocab_size)
+
+
+def _cycle_qparams(c, dt, agree_frac=None):
+    """Zero-layer-weight int8 params whose lm_head encodes a DETERMINISTIC
+    token-transition table: with zero layer matmuls the residual stream is
+    exactly the embedding, and with one-hot embeddings the logits are
+    ``lm_head[token, :]`` — so ``next = argmax_j lm_head[token, j]`` is a
+    programmable map. The target walks the cycle ``i → (i+1) % cycle``; a
+    draft with ``agree_frac=p`` matches the target's map on a seeded-RANDOM
+    p-fraction of states (Bernoulli per state) and proposes ``(i+2) %
+    cycle`` on the rest. Random placement matters: each round starts right
+    after a correction, so with EVENLY-spaced disagreements the measured
+    acceptance is the mean run length p/(1-p) (measured r5: 0.583/proposal
+    at p=0.7 — flattering); Bernoulli placement makes the leading-agree run
+    geometric, i.e. exactly the iid acceptance statistics a real draft with
+    per-token agreement p produces. Acceptance is then MEASURED through the
+    engine, not derived (VERDICT r4 ask 1). Decode cost is
+    value-independent (same shapes/dtypes as ``_zero_qparams``).
+
+    The cycle is as long as the one-hot embedding allows (hidden_size): the
+    accept/correct dynamics are DETERMINISTIC, so a short cycle can lock
+    into a periodic orbit whose agreement statistics deviate from p (a
+    256-state cycle measured 0.35/proposal at dialed 0.7); 4096 states plus
+    per-row random prompt starts keep visited-state statistics near the
+    dialed fraction."""
+    cycle = _cycle_len(c)
+    ps = _zero_qparams(c, dt)
+    ps["embed"] = jnp.zeros((c.vocab_size, c.hidden_size), dt).at[
+        jnp.arange(cycle), jnp.arange(cycle)
+    ].set(1.0)
+    q = np.zeros((c.hidden_size, c.vocab_size), np.int8)
+    rng = np.random.default_rng(1234)
+    agree_states = (
+        None if agree_frac is None else rng.random(cycle) < agree_frac
+    )
+    for i in range(cycle):
+        if agree_states is None:
+            nxt = (i + 1) % cycle
+        else:
+            nxt = (i + 1) % cycle if agree_states[i] else (i + 2) % cycle
+        q[i, nxt] = 1
+    ps["lm_head"] = QuantizedTensor(
+        q=jnp.asarray(q), scale=jnp.ones((c.vocab_size,), dt)
+    )
+    return ps
+
+
+def _spec_engine_bench_multi(cfg, dcfg, params, drafts, batch, prompt_len,
+                             ticks=6, spec_k=4):
     """Speculative serving throughput through ``InferenceEngine.step()``:
     each tick runs ``speculative_rounds`` fused propose→verify→accept
     rounds in ONE dispatch (r4 — the synchronous per-round tick paid 2+
-    tunnel round trips per round). Returns ``(tok_s, acceptance)`` measured
-    over the timed ticks."""
+    tunnel round trips per round).
+
+    ``drafts`` is ``[(name, build_dparams), …]`` (LAZY builders — five
+    resident 7B-class drafts at once would exhaust HBM next to the target)
+    measured back to back on ONE engine: the draft weights are a traced
+    ARGUMENT of the fused-rounds executable, so swapping ``eng.draft``
+    between runs measures every acceptance point without a fresh ~minutes
+    remote compile each; the previous draft's arrays are dropped first.
+    Between drafts the live sessions are cancelled, drained, and
+    resubmitted (fresh target+draft prefills). Returns
+    ``{name: (tok_s, measured acceptance)}`` over the timed ticks."""
     from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
     from distributed_llm_inference_tpu.engine import InferenceEngine
     from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
@@ -688,35 +749,69 @@ def _spec_engine_bench(cfg, dcfg, params, dparams, batch, prompt_len,
     # on this platform's tunnel regardless of payload, so more rounds per
     # dispatch amortize it (device compute is ~33 ms/round at b8 7B).
     rounds = 6
-    max_seq = prompt_len + 1 + (2 + ticks) * rounds * (spec_k + 1)
+    max_seq = prompt_len + 1 + (3 + ticks) * rounds * (spec_k + 1)
     max_seq = ((max_seq + 31) // 32) * 32
     ecfg = EngineConfig(
         max_batch_size=batch, max_seq_len=max_seq,
         prefill_buckets=(prompt_len,), decode_windows=(),
         speculative_k=spec_k, speculative_rounds=rounds,
+        # Pin the PURE speculative path: the adaptive controller would
+        # (correctly) bail to plain decode at the low-acceptance points,
+        # and these measurements exist to characterize speculation itself.
+        speculative_adaptive=False,
         dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
     )
+    first = drafts[0][1]()
+    jax.block_until_ready(first)
     eng = InferenceEngine(
         cfg, params, ecfg, CacheConfig(kind="dense", kv_quant="int8"),
-        draft=(dcfg, dparams),
+        draft=(dcfg, first),
     )
+    del first
     opts = SamplingOptions(max_new_tokens=1_000_000, eos_token_id=-1,
                            speculative=True)
-    for _ in range(batch):
-        eng.submit([1] * prompt_len, opts)
-    eng.step()  # admission + prefills (target & draft) + compile/warm tick
-    s0 = dict(eng.spec_stats)
-    t0 = time.perf_counter()
-    delivered = 0
-    for _ in range(ticks):
-        for _, tok, _fin in eng.step():
-            if tok != -1:
-                delivered += 1
-    dt = time.perf_counter() - t0
-    proposed = eng.spec_stats["proposed"] - s0["proposed"]
-    accepted = eng.spec_stats["accepted"] - s0["accepted"]
-    acc = accepted / proposed if proposed else 0.0
-    return delivered / dt, acc
+    # Per-row random prompt starts (tokens on the transition cycle): rows
+    # then sample DIFFERENT orbits of the deterministic accept/correct
+    # dynamics, so the measured agreement averages out orbit bias.
+    cyc = _cycle_len(cfg)
+    prng = np.random.default_rng(7)
+    prompts_ = [
+        prng.integers(0, cyc, size=prompt_len).tolist() for _ in range(batch)
+    ]
+    out = {}
+    for i, (name, build) in enumerate(drafts):
+        if i:  # the constructor already holds drafts[0]
+            eng.draft = (dcfg, None)  # drop the previous draft's arrays
+            eng.draft = (dcfg, build())
+        gids = [eng.submit(p, opts) for p in prompts_]
+        # Admission + prefills, then TWO unmeasured ticks: the pipelined
+        # spec path dispatches on the first step and pays first-tick sync
+        # (and any residual compile) on the second — neither belongs in
+        # the timed window.
+        eng.step()
+        eng.step()
+        eng.step()
+        s0 = dict(eng.spec_stats)
+        t0 = time.perf_counter()
+        delivered = 0
+        for _ in range(ticks):
+            for _, tok, _fin in eng.step():
+                if tok != -1:
+                    delivered += 1
+        dt = time.perf_counter() - t0
+        proposed = eng.spec_stats["proposed"] - s0["proposed"]
+        accepted = eng.spec_stats["accepted"] - s0["accepted"]
+        out[name] = (
+            delivered / dt, accepted / proposed if proposed else 0.0
+        )
+        for g in gids:
+            eng.cancel(g)
+        drain = 0
+        while eng.has_work() and drain < 100:
+            eng.step()
+            drain += 1
+        eng.collect_finished()
+    return out
 
 
 def _speculative_phase() -> dict:
@@ -736,56 +831,51 @@ def _speculative_phase() -> dict:
     cfg = LLAMA2_7B if on_tpu else TINY
     dcfg = _dc.replace(cfg, num_layers=4 if on_tpu else 1)
     dt = jnp.bfloat16 if on_tpu else jnp.float32
-    params = _zero_qparams(cfg, dt)
-    jax.block_until_ready(params)
     spec_k = 4
 
-    def _disagreeing_draft():
-        dparams = _zero_qparams(dcfg, dt)
-        # embed=1 rides the residual stream to the head (zero matmuls add
-        # nothing); a hot lm_head column then makes argmax = 1 ≠ target's 0.
-        dparams["embed"] = jnp.ones_like(dparams["embed"])
-        lm = dparams["lm_head"]
-        dparams["lm_head"] = QuantizedTensor(
-            q=lm.q.at[:, 1].set(1), scale=lm.scale
-        )
-        return dparams
+    def _cycle_params(c, agree_frac=None):
+        return _cycle_qparams(c, dt, agree_frac)
 
     err = None
     for batch in ((8, 4) if on_tpu else (8,)):
         try:
             prompt = 128 if on_tpu else 16
-            tok_full, acc_full = _spec_engine_bench(
-                cfg, dcfg, params, _zero_qparams(dcfg, dt), batch,
-                prompt_len=prompt,
-            )
-            tok_zero, acc_zero = _spec_engine_bench(
-                cfg, dcfg, params, _disagreeing_draft(), batch,
-                prompt_len=prompt,
+            # The cycle-walking TARGET: decode cost identical to zero
+            # weights (same shapes), but the emitted stream visits the
+            # transition cycle so dialed-agreement drafts produce MEASURED
+            # mid-range acceptance (VERDICT r4 ask 1 — the r4 bench had
+            # only the p=1 and p=0 endpoints plus a derived midpoint).
+            tparams = _cycle_params(cfg)
+            jax.block_until_ready(tparams)
+            drafts = [
+                ("full", lambda: _cycle_params(dcfg)),   # agrees everywhere
+                ("p85", lambda: _cycle_params(dcfg, 0.85)),
+                ("p70", lambda: _cycle_params(dcfg, 0.70)),
+                ("p50", lambda: _cycle_params(dcfg, 0.50)),
+                ("zero", lambda: _cycle_params(dcfg, 0.0)),  # never agrees
+            ]
+            res = _spec_engine_bench_multi(
+                cfg, dcfg, tparams, drafts, batch, prompt_len=prompt,
             )
             # Plain fused-decode engine at the SAME batch: the number
-            # speculation must beat.
+            # speculation must beat. Reuses the cycle target (decode cost
+            # is value-independent) — a SECOND resident 7B tree alongside
+            # it OOMed the 16 GB chip.
             tok_plain, *_ = _engine_decode_bench(
-                cfg, params, batch, prompt_len=prompt, ticks=8,
+                cfg, tparams, batch, prompt_len=prompt, ticks=8,
             )
         except Exception as e:
             err = repr(e)
             continue
-        # Round latencies from the bounds: at acceptance 1 a round yields
-        # k+1 tokens, at 0 it yields 1 — same device work either way, so
-        # both measure tokens/round-time; interpolate 70% agreement.
-        rate_full = tok_full / (spec_k + 1)   # rounds/s (upper measurement)
-        p = 0.7
-        e_p = p * (1 - p**spec_k) / (1 - p) + 1
-        tok_p70 = rate_full * e_p
-        return {
+        tok_full, acc_full = res["full"]
+        tok_zero, acc_zero = res["zero"]
+        doc = {
             "tok_s": round(tok_full, 2), "batch": batch, "ttft_ms": None,
             "acceptance": round(acc_full, 3),
             "tok_s_zero_acceptance": round(tok_zero, 2),
             "acceptance_zero": round(acc_zero, 3),
             "tok_s_plain_same_batch": round(tok_plain, 2),
             "speedup_vs_plain": round(tok_full / tok_plain, 2),
-            "tok_s_at_acceptance_0p7_derived": round(tok_p70, 2),
             "spec_k": spec_k, "draft_layers": dcfg.num_layers,
             "spec_rounds_per_dispatch": 6,
             "scope": "InferenceEngine.step() end to end",
@@ -793,6 +883,12 @@ def _speculative_phase() -> dict:
             "device": str(jax.devices()[0].device_kind),
             "model": "llama-2-7b-shape" if on_tpu else "tiny-cpu-fallback",
         }
+        for name in ("p85", "p70", "p50"):
+            tok_p, acc_p = res[name]
+            doc[f"tok_s_{name}_measured"] = round(tok_p, 2)
+            doc[f"acceptance_{name}"] = round(acc_p, 3)
+            doc[f"speedup_vs_plain_{name}"] = round(tok_p / tok_plain, 2)
+        return doc
     raise RuntimeError(f"speculative phase failed at every batch: {err}")
 
 
@@ -1037,6 +1133,8 @@ def _distributed_phase() -> dict:
                     tx.put("big", payload)
                 th.join()
                 dt = time.perf_counter() - t0
+                if not done:  # drain died mid-transfer: no fake number
+                    return {**out, "error": f"{mb}MB frame drain failed"}
                 out[f"mb_per_s_{mb}mb_frames"] = round(
                     frames * size / dt / 1e6, 1
                 )
@@ -1057,6 +1155,8 @@ def _distributed_phase() -> dict:
                 t_put = time.perf_counter()
                 tx.put("park", buf)
                 th.join()
+                if not got:  # parked GET timed out: structured error
+                    return {**out, "error": "parked GET never woke"}
                 lats.append((got[0] - t_put) * 1e6)
             lats.sort()
             out["get_wake_us_p50"] = round(lats[len(lats) // 2], 1)
@@ -1123,12 +1223,17 @@ def _distributed_phase() -> dict:
                         burst(new_tokens)
                         if errs:
                             raise RuntimeError(errs[0])
+                        # Snapshot AFTER the warm burst: its compile-era,
+                        # mostly-singleton pool calls would dilute the
+                        # steady-state co-batching stat.
+                        bi0, bc0 = (n1.backend.batched_items,
+                                    n1.backend.batched_calls)
                         dt = burst(new_tokens)
                         if errs:
                             raise RuntimeError(errs[0])
                         batched = (
-                            n1.backend.batched_items,
-                            n1.backend.batched_calls,
+                            n1.backend.batched_items - bi0,
+                            n1.backend.batched_calls - bc0,
                         )
         return n_clients * new_tokens / dt, batched
 

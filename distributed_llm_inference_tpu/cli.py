@@ -387,7 +387,8 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--cache", default="paged",
                    choices=("paged", "dense", "sink"))
     l.add_argument("--int8", action="store_true")
-    l.add_argument("--quantize", default=None, choices=("int8", "int4"))
+    l.add_argument("--quantize", default=None,
+                   choices=("int8", "int4", "int8_outlier"))
     l.add_argument("--kv-quant", default=None, choices=("int8",),
                    help="int8 KV cache (dense/paged): halves KV HBM "
                         "traffic; on TPU the dense kind also unlocks the "

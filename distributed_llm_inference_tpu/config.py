@@ -177,7 +177,9 @@ class EngineConfig:
     max_seq_len: int = 4096
     max_new_tokens: int = 512
     dtype: str = "bfloat16"
-    quantization: Optional[str] = None  # None | "int8" | "int4"
+    # None | "int8" | "int4" | "int8_outlier" (LLM.int8()-style fp outlier
+    # channels beside the int8 body — the reference's bnb threshold=5.0).
+    quantization: Optional[str] = None
     # Decode attention-window buckets (dense cache kinds): each decode step
     # reads only the smallest bucket >= the longest live row instead of the
     # full max_seq_len buffer (one executable per bucket; big bandwidth win
@@ -213,6 +215,20 @@ class EngineConfig:
     pipelined_ticks: bool = True
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled
+    # Adaptive speculation (pipelined spec engines): when the MEASURED
+    # tokens-per-round EMA sags below ``speculative_probe_below`` (None =
+    # auto, 0.55*(k+1)), the engine probes the plain fused-decode path for
+    # ``speculative_probe_len`` ticks and serves whichever path measured
+    # faster, re-probing every ``speculative_probe_period`` ticks. Rows'
+    # token streams are identical either way (both are greedy argmax);
+    # switching back re-syncs the draft cache (one chunked draft prefill
+    # per speculative session). Addresses low-acceptance regimes where a
+    # round's k draft forwards + verify cost more than the tokens they
+    # yield.
+    speculative_adaptive: bool = True
+    speculative_probe_below: Optional[float] = None
+    speculative_probe_period: int = 48
+    speculative_probe_len: int = 8
     # Propose→verify→accept ROUNDS fused into one device dispatch (draft
     # scan, k+1-position verify, acceptance, cache rollback and draft
     # catch-up all in-graph, lax.scan over rounds). Each synchronous

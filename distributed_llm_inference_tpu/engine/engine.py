@@ -83,10 +83,15 @@ class InferenceEngine:
         self.cfg = cfg
         self._mesh_cfg = mesh_cfg
         self.ecfg = engine_cfg or EngineConfig()
-        if self.ecfg.quantization in ("int8", "int4"):
+        if self.ecfg.quantization in ("int8", "int4", "int8_outlier"):
             from ..ops.quant import quantize_params
 
             qkw = {}
+            if self.ecfg.quantization == "int8_outlier":
+                # LLM.int8()-style decomposition (the reference's
+                # bitsandbytes threshold=5.0 capability): 32 fp input
+                # channels per projection ride a side matmul.
+                qkw["outlier_channels"] = 32
             if self.ecfg.quantization == "int4":
                 # Unsharded (or dp/ep-only) serving decodes through the
                 # Pallas half-split kernel; tp/pp meshes keep the grouped
@@ -612,32 +617,72 @@ class InferenceEngine:
             R = self.spec_rounds
 
             def _spec_round_fn(params_, dparams_, tokens, cache, dcache,
-                               spec, active, eos_ids, budget, key, sp):
+                               spec, active, eos_ids, budget, key, sp,
+                               catch_tok, catch):
                 """``R`` fused speculative rounds. Returns
-                ``(pack [R, B, k+3] int32, tok_carry [B, 1], cache,
-                dcache)`` — pack = emits (k+1 slots, -1 padded) ++ acc ++
-                palive per round, ONE array so the host pays ONE fetch
-                (a device_get on this platform's tunnel costs ~180 ms
-                regardless of size; three of them per tick was most of the
-                r3 speculative path's 6x loss)."""
+                ``(pack [R, B, k+3] int32, tok_carry [B, 1],
+                catch_tok [B, 1], catch [B], cache, dcache)`` — pack =
+                emits (k+1 slots, -1 padded) ++ acc ++ palive per round,
+                ONE array so the host pays ONE fetch (a device_get on this
+                platform's tunnel costs ~180 ms regardless of size; three
+                of them per tick was most of the r3 speculative path's 6x
+                loss).
+
+                ``catch_tok``/``catch`` carry the draft's PENDING catch-up
+                token: on full acceptance the draft never consumed its own
+                final proposal, and r4 paid a dedicated masked draft
+                forward per round (~2.3 ms — a full sweep of the draft
+                weights) to feed it back. Instead the NEXT round's first
+                draft step consumes ``[p_k, tok]`` as a 2-position forward
+                (per-row ``num_new = 1 + catch``) — the catch-up rides a
+                weight sweep that was happening anyway, across dispatches
+                too (the pending pair is device-carried alongside the
+                token carry and returned for the next tick)."""
                 b_ = tokens.shape[0]
                 jidx = jnp.arange(sk + 1, dtype=jnp.int32)[None, :]
 
                 def one_round(carry, i):
-                    tok, cache, dcache, alive, used = carry
+                    tok, cache, dcache, alive, used, ctok, cm = carry
                     palive = (alive & spec).astype(jnp.int32)
+
+                    # First draft step folds the pending catch-up in:
+                    # rows with cm consume [p_k, tok] (2 positions), the
+                    # rest [tok, pad] (1); the next-token logits sit at
+                    # position num_new-1 = cm.
+                    cmi = cm.astype(jnp.int32)
+                    first_seq = jnp.where(
+                        cm[:, None],
+                        jnp.concatenate([ctok, tok], axis=1),
+                        jnp.concatenate(
+                            [tok, jnp.zeros((b_, 1), jnp.int32)], axis=1
+                        ),
+                    )
+                    lgd, dcache = llama.model_apply(
+                        dcfg, dparams_, first_seq, dcache,
+                        palive * (1 + cmi),
+                    )
+                    first_nxt = jnp.argmax(
+                        jnp.take_along_axis(
+                            lgd, cmi[:, None, None], axis=1
+                        )[:, 0],
+                        -1,
+                    ).astype(jnp.int32)
 
                     def dstep(c2, _):
                         t2, dc = c2
-                        lgd, dc = llama.model_apply(
+                        lgd2, dc = llama.model_apply(
                             dcfg, dparams_, t2, dc, palive
                         )
-                        nxt = jnp.argmax(lgd[:, 0], -1).astype(jnp.int32)
+                        nxt = jnp.argmax(lgd2[:, 0], -1).astype(jnp.int32)
                         return (nxt[:, None], dc), nxt
 
-                    (_, dcache), prop = jax.lax.scan(
-                        dstep, (tok, dcache), None, length=sk
+                    (_, dcache), rest = jax.lax.scan(
+                        dstep, (first_nxt[:, None], dcache), None,
+                        length=sk - 1,
                     )
+                    prop = jnp.concatenate(
+                        [first_nxt[None, :], rest], axis=0
+                    )  # [k, B]
                     prop_t = prop.T  # [B, k]
                     seq = jnp.concatenate(
                         [tok, jnp.where(spec[:, None], prop_t, 0)], axis=1
@@ -696,15 +741,16 @@ class InferenceEngine:
                         lengths=dcache.lengths - d_roll
                     )
                     # Full acceptance: the draft never consumed its own
-                    # final proposal — one masked catch-up forward.
-                    catch = (palive == 1) & (count == sk + 1)
-                    catch_tok = jnp.take_along_axis(
+                    # final proposal — record it as the next round's (or
+                    # next DISPATCH's) pending catch-up instead of paying a
+                    # dedicated draft forward here. Inactive rows keep any
+                    # pending pair untouched.
+                    new_catch = (palive == 1) & (count == sk + 1)
+                    new_ctok = jnp.take_along_axis(
                         cand, jnp.maximum(count - 2, 0)[:, None], axis=1
                     )
-                    _, dcache = llama.model_apply(
-                        dcfg, dparams_, catch_tok, dcache,
-                        catch.astype(jnp.int32),
-                    )
+                    cm = jnp.where(palive == 1, new_catch, cm)
+                    ctok = jnp.where(palive[:, None] == 1, new_ctok, ctok)
 
                     emit = jnp.where(jidx < count[:, None], cand, -1)
                     last = jnp.take_along_axis(
@@ -712,7 +758,7 @@ class InferenceEngine:
                     )
                     tok = jnp.where(count[:, None] > 0, last, tok)
                     return (
-                        (tok, cache, dcache, alive, used + count),
+                        (tok, cache, dcache, alive, used + count, ctok, cm),
                         (emit, acc, palive),
                     )
 
@@ -720,19 +766,20 @@ class InferenceEngine:
                 # UNROLLED rounds: under lax.scan XLA re-stages the loop
                 # bodies' small invariant operands (head scales, norms, rope
                 # tables) every iteration. R is small.
-                carry = (tokens, cache, dcache, active, zero)
+                carry = (tokens, cache, dcache, active, zero, catch_tok,
+                         catch)
                 outs = []
                 for i in range(R):
                     carry, out = one_round(carry, i)
                     outs.append(out)
-                (tok, cache, dcache, _, _) = carry
+                (tok, cache, dcache, _, _, catch_tok, catch) = carry
                 pack = jnp.stack([
                     jnp.concatenate(
                         [emit, acc[:, None], palive[:, None]], axis=1
                     )
                     for emit, acc, palive in outs
                 ])  # [R, B, k+3]
-                return pack, tok, cache, dcache
+                return pack, tok, catch_tok, catch, cache, dcache
 
             sdk = dict(donate_argnums=(3, 4)) if donate else {}
             self._spec_rounds_fn = self._with_mesh(
@@ -742,9 +789,28 @@ class InferenceEngine:
             # result + bookkeeping, and the device-resident token carry
             # (tick N dispatches from tick N-1's final tokens WITHOUT
             # fetching them — the fetch overlaps tick N's compute).
+            # ``_spec_catch`` is the device-carried pending draft catch-up
+            # pair (token, mask) the next tick's first draft step consumes.
             self._spec_pending = None
             self._spec_carry = None
+            self._spec_catch = None
             self._spec_carry_ok = np.zeros(self.batch, np.bool_)
+            self._catch_combine = self._with_mesh(jax.jit(
+                lambda c, u: c & u
+            ))
+            # Adaptive speculation (config.py): a throughput A/B controller.
+            # ``mode``: "spec" | "probe_plain" | "plain" | "probe_spec".
+            # Rates are measured tokens/s over windows of probe_len ticks;
+            # probing the plain path is gated on the MEASURED
+            # tokens-per-round EMA sagging below the break-even band (high
+            # acceptance never pays the probe's mode-switch cost).
+            self._spec_suspended = False
+            self._spec_ctl = {
+                "mode": "spec", "win_t0": None, "win_tok0": 0.0,
+                "win_ticks": 0, "spec_rate": None, "plain_rate": None,
+                "cooldown": 0, "stat0": dict(self.spec_stats),
+                "tpr_ema": None,
+            }
 
     def _sink_cap(self) -> int:
         """Stream-length bound for sink sessions. The bf16 ring rotates at
@@ -969,6 +1035,16 @@ class InferenceEngine:
                 self._admit(produced)
                 if any(slot is not None for slot in self.slots):
                     self._decode_tick(produced)
+                elif (
+                    self.draft is not None
+                    and self._spec_pending is not None
+                ):
+                    # Every speculative session left (cancel/finish burst)
+                    # with a tick in flight and nothing was admitted:
+                    # _decode_tick won't run to drain it, so resolve here —
+                    # otherwise has_work() reports the orphaned pending
+                    # tick forever.
+                    self._spec_flush(produced)
         return produced
 
     def has_work(self) -> bool:
@@ -1311,31 +1387,170 @@ class InferenceEngine:
         if self._session_speculative(s):
             # Mirror the FULL prompt into the draft cache (no prefix sharing
             # there; proposals start right after the prompt).
-            dparams = self.draft[1]
-            cap = self.ecfg.prefill_buckets[-1]
-            off = 0
-            while len(prompt) - off > cap:
-                chunk = prompt[off : off + cap]
-                self.draft_cache = self._draft_prefill(
-                    dparams, jnp.asarray(chunk)[None, :], self.draft_cache,
-                    s.slot, jnp.int32(len(chunk)),
-                )
-                off += cap
-            rest = prompt[off:]
-            bucket = self._bucket_for(len(rest))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(rest)] = rest
-            self.draft_cache = self._draft_prefill(
-                dparams, jnp.asarray(padded), self.draft_cache, s.slot,
-                jnp.int32(len(rest)),
-            )
+            self._draft_mirror(prompt, s.slot)
 
-    def _session_speculative(self, s: Session) -> bool:
+    def _draft_mirror(self, tokens, slot) -> None:
+        """Chunked prefill of ``tokens`` into the draft cache's ``slot`` row
+        (admission-time prompt mirror AND adaptive-resume resync share this
+        so their chunking can never drift apart)."""
+        dparams = self.draft[1]
+        cap = self.ecfg.prefill_buckets[-1]
+        off = 0
+        while len(tokens) - off > cap:
+            chunk = tokens[off : off + cap]
+            self.draft_cache = self._draft_prefill(
+                dparams, jnp.asarray(chunk)[None, :], self.draft_cache,
+                jnp.int32(slot), jnp.int32(len(chunk)),
+            )
+            off += cap
+        rest = tokens[off:]
+        bucket = self._bucket_for(len(rest))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(rest)] = rest
+        self.draft_cache = self._draft_prefill(
+            dparams, jnp.asarray(padded), self.draft_cache, jnp.int32(slot),
+            jnp.int32(len(rest)),
+        )
+
+    def _session_wants_spec(self, s: Session) -> bool:
         return (
             self.draft is not None
             and s.options.speculative
             and s.options.temperature == 0.0
         )
+
+    def _session_speculative(self, s: Session) -> bool:
+        """Speculating NOW — wants it and the adaptive controller has not
+        suspended speculation engine-wide (the greedy token streams are
+        identical either way, so suspension is invisible to outputs)."""
+        return self._session_wants_spec(s) and not self._spec_suspended
+
+    # -- adaptive speculation (throughput A/B controller) ---------------------
+
+    def _draft_resync_all(self) -> None:
+        """Re-mirror every speculative session's accepted stream (prompt +
+        generated[:-1]) into the draft cache — required after plain-mode
+        ticks advanced sessions without the draft. One chunked draft
+        prefill per session; cost ≈ one draft weight sweep per
+        prefill-bucket chunk."""
+        for slot, gid in enumerate(self.slots):
+            if gid is None:
+                continue
+            s = self.sessions[gid]
+            if not self._session_wants_spec(s):
+                continue
+            self.draft_cache = self.draft_cache.reset_rows(
+                np.arange(self.batch) == slot
+            )
+            self._draft_mirror(list(s.prompt) + s.generated[:-1], slot)
+
+    def _spec_suspend(self, produced) -> None:
+        if self._spec_pending is not None:
+            self._spec_flush(produced)
+        self._spec_suspended = True
+
+    def _spec_resume(self) -> None:
+        self._draft_resync_all()
+        # Fresh tokens next dispatch; the device-carried catch pair is
+        # gated off with the carry (the resync already consumed everything).
+        self._spec_carry_ok[:] = False
+        self._spec_suspended = False
+
+    def _decode_tokens_total(self) -> float:
+        return self.metrics.get_counter("decode_tokens")
+
+    def _spec_adapt(self, produced) -> None:
+        """Windowed throughput controller (config.py's speculative_probe_*
+        knobs). Measures tokens/s of the CURRENT path over windows of
+        ``probe_len`` ticks; when spec-mode tokens-per-round sags below the
+        break-even band it probes the plain fused path, serves whichever
+        measured faster, and re-probes speculation every ``probe_period``
+        ticks. Token streams are bit-identical in both modes."""
+        if self.draft is None or not self.ecfg.speculative_adaptive:
+            return
+        c = self._spec_ctl
+        if not any(
+            g is not None and self._session_wants_spec(self.sessions[g])
+            for g in self.slots
+        ):
+            # Disengaged tick (no speculative sessions resident): the next
+            # engaged window must NOT span this gap's wall time or its
+            # non-speculative tokens.
+            c["win_t0"] = None
+            return
+        now = time.monotonic()
+        tokens = self._decode_tokens_total()
+        if c["win_t0"] is None or c.get("skip", 0) > 0:
+            # (Re-)baseline: after engagement gaps and for the first tick
+            # after a mode transition — that tick absorbs the new path's
+            # one-time jit compile (~minutes through the remote compiler)
+            # and the transition's flushed/resynced tokens, which would
+            # otherwise poison the rate EMA.
+            c["skip"] = max(0, c.get("skip", 0) - 1)
+            c.update(win_t0=now, win_tok0=tokens, win_ticks=0,
+                     stat0=dict(self.spec_stats))
+            return
+        c["win_ticks"] += 1
+        if c["win_ticks"] < max(2, self.ecfg.speculative_probe_len):
+            return
+        # Window boundary: fold this window's rate into the mode's EMA.
+        rate = (tokens - c["win_tok0"]) / max(now - c["win_t0"], 1e-9)
+        mode = c["mode"]
+        rkey = "plain_rate" if mode in ("probe_plain", "plain") else "spec_rate"
+        c[rkey] = rate if c[rkey] is None else 0.5 * c[rkey] + 0.5 * rate
+        if mode in ("spec", "probe_spec"):
+            steps_d = self.spec_stats["steps"] - c["stat0"]["steps"]
+            if steps_d > 0:
+                tpr = 1.0 + (
+                    self.spec_stats["accepted"] - c["stat0"]["accepted"]
+                ) / steps_d
+                c["tpr_ema"] = tpr if c["tpr_ema"] is None else (
+                    0.5 * c["tpr_ema"] + 0.5 * tpr
+                )
+        c.update(win_t0=now, win_tok0=tokens, win_ticks=0,
+                 stat0=dict(self.spec_stats))
+        c["cooldown"] = max(0, c["cooldown"] - 1)
+
+        k = self.ecfg.speculative_k
+        gate = (
+            self.ecfg.speculative_probe_below
+            if self.ecfg.speculative_probe_below is not None
+            else 0.55 * (k + 1)
+        )
+        period_windows = max(
+            1,
+            self.ecfg.speculative_probe_period
+            // max(2, self.ecfg.speculative_probe_len),
+        )
+        if mode == "spec":
+            if (
+                c["tpr_ema"] is not None
+                and c["tpr_ema"] < gate
+                and c["cooldown"] == 0
+            ):
+                self._spec_suspend(produced)
+                c.update(mode="probe_plain", win_t0=None, skip=1)
+                self.metrics.counter("spec_adapt_probes")
+        elif mode == "probe_plain":
+            # One full window of plain measured — decide.
+            if c["plain_rate"] > (c["spec_rate"] or 0.0):
+                c["mode"] = "plain"
+                self.metrics.counter("spec_adapt_suspensions")
+            else:
+                self._spec_resume()
+                c.update(mode="spec", win_t0=None, skip=1)
+            c["cooldown"] = period_windows
+        elif mode == "plain":
+            if c["cooldown"] == 0:
+                self._spec_resume()
+                c.update(mode="probe_spec", win_t0=None, skip=1)
+        elif mode == "probe_spec":
+            if (c["spec_rate"] or 0.0) >= (c["plain_rate"] or 0.0):
+                c["mode"] = "spec"
+            else:
+                self._spec_suspend(produced)
+                c.update(mode="plain", win_t0=None, skip=1)
+            c["cooldown"] = period_windows
 
     # -- pipelined ticks ------------------------------------------------------
 
@@ -1468,6 +1683,7 @@ class InferenceEngine:
         self.metrics.counter("decode_tokens", delivered_total)
 
     def _decode_tick(self, produced) -> None:
+        self._spec_adapt(produced)
         if self.draft is not None and any(
             g is not None and self._session_speculative(self.sessions[g])
             for g in self.slots
@@ -1713,19 +1929,31 @@ class InferenceEngine:
                 jnp.asarray(fresh), self._spec_carry,
                 jnp.asarray(use_carry),
             )
+        if self._spec_catch is None:
+            ctok_dev = jnp.zeros((b, 1), jnp.int32)
+            cmask_dev = jnp.zeros((b,), jnp.bool_)
+        else:
+            ctok_dev, cmask_dev = self._spec_catch
+            # Rows whose carry is invalid (fresh admissions) also have a
+            # freshly prefilled draft cache — no pending catch-up.
+            cmask_dev = self._catch_combine(
+                cmask_dev, jnp.asarray(use_carry)
+            )
         self._flush_installs()
         with self.metrics.timer("decode_step"), span(
             "speculative_rounds", self.spans, batch=int(active.sum()),
         ):
-            pack_d, tok_d, self.cache, self.draft_cache = (
+            pack_d, tok_d, ctok_d, cmask_d, self.cache, self.draft_cache = (
                 self._spec_rounds_fn(
                     self.params, self.draft[1], tokens_dev,
                     self.cache, self.draft_cache, jnp.asarray(spec),
                     jnp.asarray(active), jnp.asarray(eos_ids),
                     jnp.asarray(budget), self._next_key(), sp,
+                    ctok_dev, cmask_dev,
                 )
             )
         self._spec_carry = tok_d
+        self._spec_catch = (ctok_d, cmask_d)
         self._spec_carry_ok = self._spec_carry_ok | active
         # Conservative in-flight charge: the tick can deliver at most
         # min(R*(k+1), budget) per row.

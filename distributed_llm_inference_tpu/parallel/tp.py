@@ -89,8 +89,18 @@ def _maybe_qspec(param: Any, spec: P) -> Any:
     group axis (whole groups per device), replicating within a group."""
     from ..ops.quant import (
         QuantizedTensor, QuantizedTensor4, QuantizedTensor4Split,
+        QuantizedTensorOutlier,
     )
 
+    if isinstance(param, QuantizedTensorOutlier):
+        # Outlier indices address the CONTRACTED axis: replicate them and
+        # the fp side-weights' K axis (K ≈ 32 — the side matmul is noise);
+        # the out axis follows the body's sharding.
+        return QuantizedTensorOutlier(
+            q=spec, scale=P(*spec[:-2], spec[-1]),
+            outlier_idx=P(*spec[:-2], None),
+            outlier_w=P(*spec[:-2], None, spec[-1]),
+        )
     if isinstance(param, QuantizedTensor):
         return QuantizedTensor(q=spec, scale=P(*spec[:-2], spec[-1]))
     if isinstance(param, QuantizedTensor4):

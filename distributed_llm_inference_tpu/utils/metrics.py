@@ -43,6 +43,12 @@ class Metrics:
             with self._lock:
                 self._timings[name].append(time.perf_counter() - t0)
 
+    def get_counter(self, name: str) -> float:
+        """One counter's current value (snapshot() is unsuitable for
+        per-tick reads — it sorts every timing list)."""
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._timings[name].append(value)

@@ -451,3 +451,99 @@ def test_int4_stacked_view_matches_per_layer_kernel():
             np.asarray(matmul(xp, view)), oracle(xp, layer)[:, :OUT],
             rtol=2e-2, atol=8e-3,
         )
+
+
+# -- outlier-aware int8 (LLM.int8()-style decomposition) ---------------------
+
+
+def test_outlier_int8_rescues_planted_outlier_rows():
+    """Weights with a few huge input rows (the regime bitsandbytes'
+    threshold=5.0 exists for, reference utils/model.py:102-108): plain
+    per-channel int8 loses most of its resolution to the outliers; the
+    decomposition carries them in fp and recovers near-int8-clean error."""
+    from distributed_llm_inference_tpu.ops.quant import quantize_int8_outlier
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((256, 64)).astype(np.float32)
+    hot = rng.choice(256, size=8, replace=False)
+    w[hot] *= 100.0  # planted activation-outlier-style rows
+    x = rng.standard_normal((16, 256)).astype(np.float32)
+    exact = x @ w
+
+    def rel_err(y):
+        return float(np.linalg.norm(np.asarray(y) - exact)
+                     / np.linalg.norm(exact))
+
+    e_plain = rel_err(matmul(jnp.asarray(x),
+                             quantize_int8(jnp.asarray(w), jnp.float32)))
+    qo = quantize_int8_outlier(jnp.asarray(w), 16, scale_dtype=jnp.float32)
+    e_out = rel_err(matmul(jnp.asarray(x), qo))
+    # The planted rows were selected as outliers...
+    assert set(hot).issubset(set(np.asarray(qo.outlier_idx).tolist()))
+    # ...and the decomposition recovers well over an order of magnitude.
+    assert e_out < e_plain / 10
+
+
+def test_outlier_int8_act_scales_select_channels():
+    from distributed_llm_inference_tpu.ops.quant import quantize_int8_outlier
+
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    act = np.zeros((64,), np.float32)
+    act[[3, 17, 40]] = 100.0  # calibration says these channels run hot
+    qo = quantize_int8_outlier(jnp.asarray(w), 3,
+                               act_scales=jnp.asarray(act))
+    assert sorted(np.asarray(qo.outlier_idx).tolist()) == [3, 17, 40]
+
+
+def test_outlier_int8_stacked_layers_and_model_forward():
+    """quantize_params(outlier_channels=...) on the stacked layer pytree:
+    model_apply runs through the lax.scan layer slice and tracks the bf16
+    model closely."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(3), jnp.float32)
+    qp = quantize_params(params, scale_dtype=jnp.float32,
+                         outlier_channels=4)
+    from distributed_llm_inference_tpu.ops.quant import (
+        QuantizedTensorOutlier,
+    )
+
+    assert isinstance(qp["layers"]["wq"], QuantizedTensorOutlier)
+    assert isinstance(qp["lm_head"], QuantizedTensorOutlier)
+    cache = DenseKVCache.create(
+        CFG.num_layers, 1, 32, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+    )
+    qcache = DenseKVCache.create(
+        CFG.num_layers, 1, 32, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+    )
+    toks = jnp.asarray([[5, 9, 2, 11]], jnp.int32)
+    n = jnp.full((1,), 4, jnp.int32)
+    ref, _ = llama.model_apply(CFG, params, toks, cache, n)
+    got, _ = llama.model_apply(CFG, qp, toks, qcache, n)
+    err = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+    assert err < 0.05
+
+
+def test_engine_int8_outlier_generates_and_tp_shards():
+    """EngineConfig(quantization="int8_outlier") serves, and the outlier
+    leaves shard over a tp mesh (pspec coverage in parallel/tp.py)."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(4), jnp.float32)
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                     max_seq_len=32, dtype="float32",
+                     quantization="int8_outlier"),
+        CacheConfig(kind="dense"),
+    )
+    outs = eng.generate([[1, 2, 3]], SamplingOptions(max_new_tokens=5))
+    assert len(outs[0]) == 5
+    sharded = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                     max_seq_len=32, dtype="float32",
+                     quantization="int8_outlier"),
+        CacheConfig(kind="dense"),
+        mesh_cfg=MeshConfig(tp=2),
+    )
+    assert sharded.generate(
+        [[1, 2, 3]], SamplingOptions(max_new_tokens=5)
+    ) == outs
