@@ -352,7 +352,18 @@ class InferenceEngine:
             ``rows``/``n_valid`` ``[k]`` traced — one executable per
             (k-bucket, prompt-bucket)). k sequential single-row prefills
             cost k weight sweeps at ~25% MFU each plus k tunnel round
-            trips; batched rows share every weight fetch."""
+            trips; batched rows share every weight fetch.
+
+            This IN-PLACE form (gather rows → compute → scatter back, full
+            cache in one program) is kept for the PAGED pool, whose shared
+            page arrays can't live in a standalone sub-cache. Dense/sink
+            kinds use the SPLIT pair below: this platform's remote compiler
+            crashes on the combined program between b88×T256 (= 22.5k,
+            compiles) and b96×T256 (= 24.5k, crashes) — bisected r5: the
+            batched-prefill program, not the decode scan; form-independent
+            (scatter, DUS-chain, no-donation all crash) —
+            while the standalone-prefill + merge-only programs compile at
+            every serving shape tried (b160×T256 included)."""
             sub = cache.select_rows(rows)
             logits, sub = llama.model_apply(
                 cfg, params, tokens, sub, n_valid, **mkw
@@ -363,6 +374,24 @@ class InferenceEngine:
             )[:, 0]
             toks = sample(last, key, sp)
             return toks, cache
+
+        def _prefill_rows_standalone(params, tokens, sub, n_valid, key, sp):
+            """Split batched admission, program A: prefill into a FRESH
+            compact k-row cache — no [L, B, T] array anywhere in the
+            program (admission rows start at length 0, so there is nothing
+            to gather). Program B (`_merge_rows_only`) scatters the result
+            rows into the big cache."""
+            logits, sub = llama.model_apply(
+                cfg, params, tokens, sub, n_valid, **mkw
+            )
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            toks = sample(last, key, sp)
+            return toks, sub
+
+        def _merge_rows_only(cache, sub, rows):
+            return cache.merge_rows(sub, rows)
 
         def _decode_step(params, tokens, cache, active, key, sp):
             logits, cache = llama.model_apply(
@@ -456,6 +485,12 @@ class InferenceEngine:
         self._prefill = self._with_mesh(jax.jit(_prefill_row, **dk))
         self._prefill_ns = self._with_mesh(jax.jit(_prefill_row_nosample, **dk))
         self._prefill_batch = jax.jit(_prefill_rows, **dk)
+        self._prefill_batch_standalone = jax.jit(_prefill_rows_standalone, **dk)
+        mdk = (
+            dict(donate_argnums=(0,))
+            if jax.default_backend() == "tpu" else {}
+        )
+        self._merge_rows_only = jax.jit(_merge_rows_only, **mdk)
         # Batched admission needs select_rows/merge_rows (gather/scatter over
         # the batch axis) and a single-device computation: a scatter over a
         # dp/pp-sharded batch aborts under GSPMD, and ring prefill is a
@@ -1297,17 +1332,60 @@ class InferenceEngine:
             "prefill_batch", self.spans, sessions=k,
             prompt_tokens=int(n_valid.sum()),
         ):
-            toks, self.cache = self._prefill_batch(
-                self.params, jnp.asarray(tokens), self.cache,
-                jnp.asarray(rows), jnp.asarray(n_valid),
-                self._next_key(), sp,
-            )
+            sub = self._fresh_sub(nr)
+            if sub is not None:
+                # Split pair (see _prefill_rows_standalone): compact
+                # prefill with NO big-cache arrays, then a merge-only
+                # dispatch — the combined program crashes this platform's
+                # remote compiler past B×T ≈ 22.5k.
+                toks, sub = self._prefill_batch_standalone(
+                    self.params, jnp.asarray(tokens), sub,
+                    jnp.asarray(n_valid), self._next_key(), sp,
+                )
+                self.cache = self._merge_rows_only(
+                    self.cache, sub, jnp.asarray(rows)
+                )
+            else:
+                toks, self.cache = self._prefill_batch(
+                    self.params, jnp.asarray(tokens), self.cache,
+                    jnp.asarray(rows), jnp.asarray(n_valid),
+                    self._next_key(), sp,
+                )
             toks = np.asarray(jax.device_get(toks))
         self.metrics.counter("batched_prefills", k)
         for i, s in enumerate(group):
             self._finish_prefill(
                 s, int(toks[i]), np.asarray(s.prompt, np.int32), produced, 0
             )
+
+    def _fresh_sub(self, nr: int):
+        """A fresh ``nr``-row cache of the serving kind/shape for the split
+        batched-admission prefill, or None for kinds that must keep the
+        in-place program (the paged pool's page arrays are SHARED — a
+        standalone sub-cache can't hold them). Stale content is irrelevant:
+        validity derives from lengths, exactly as for gathered rows."""
+        c = self.cache
+        cfg, dtype = self.cfg, jnp.dtype(self.ecfg.dtype)
+        if isinstance(c, QuantizedDenseKVCache):
+            return QuantizedDenseKVCache.create(
+                cfg.num_layers, nr, c.max_len, cfg.num_kv_heads,
+                cfg.head_dim, dtype, use_kernel=c.use_kernel,
+            )
+        if isinstance(c, DenseKVCache):
+            return DenseKVCache.create(
+                cfg.num_layers, nr, c.max_len, cfg.num_kv_heads,
+                cfg.head_dim, dtype,
+            )
+        if isinstance(c, QuantizedSinkKVCache):
+            return QuantizedSinkKVCache.create(
+                cfg.num_layers, nr, c.window, c.num_sinks,
+                cfg.num_kv_heads, cfg.head_dim, dtype,
+                use_kernel=c.use_kernel,
+            )
+        # bf16 SinkKVCache: no select_rows/merge_rows — batch admission is
+        # off for it, so no branch here (adding one would dangle on the
+        # missing merge_rows the day select_rows appears).
+        return None
 
     def _ring_threshold(self) -> int:
         thr = self.ecfg.ring_prefill_threshold

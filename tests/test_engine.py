@@ -268,10 +268,10 @@ def test_engine_mesh_rejects_bad_configs():
             CFG, PARAMS, EngineConfig(max_batch_size=3, dtype="float32"),
             CacheConfig(kind="dense"), mesh_cfg=MeshConfig(dp=2),
         )
-    with pytest.raises(ValueError):  # pp needs the dense cache
-        InferenceEngine(
+    with pytest.raises(ValueError):  # pp: dense/paged only (sink has no
+        InferenceEngine(                 # staged write-behind tail)
             CFG, PARAMS, EngineConfig(max_batch_size=4, dtype="float32"),
-            CacheConfig(kind="paged"), mesh_cfg=MeshConfig(pp=2),
+            CacheConfig(kind="sink"), mesh_cfg=MeshConfig(pp=2),
         )
 
 
