@@ -564,6 +564,8 @@ PHASES = {
     # Transport tier (relay microbench + 2-node pipeline), CPU-scope —
     # _distributed_phase().
     "distributed": None,
+    # Prefill compute (TFLOP/s at prompt 128/512/2048) — _prefill_phase().
+    "prefill": None,
 }
 
 # Phases that skip the (redundant) prompt-128 TTFT measurement to bound
@@ -1069,6 +1071,68 @@ def _engine_phase() -> dict:
 _PHASE_CFG = {"llama3_8b_int8_kvq": (LLAMA3_8B, "llama-3-8b-shape")}
 
 
+def _prefill_phase() -> dict:
+    """Prefill compute at prompt 128/512/2048 (b1, Llama-3-8B-shape int8,
+    the north-star TTFT model): device ms + TFLOP/s (VERDICT r4 ask 2's
+    missing bench coverage). Measures the SHIPPED default path — W8A8
+    dynamic-activation int8 MXU matmuls for S >= 128 (ops/quant.py), flash
+    attention above S >= 1024 (cache/base.py), last-position-only head."""
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA3_8B if on_tpu else TINY
+    params = _zero_qparams(cfg, jnp.bfloat16 if on_tpu else jnp.float32)
+    jax.block_until_ready(params)
+
+    def model_tflops(S):
+        h, d, hq, hkv, inter, L, V = (
+            cfg.hidden_size, cfg.head_dim, cfg.num_heads, cfg.num_kv_heads,
+            cfg.intermediate_size, cfg.num_layers, cfg.vocab_size,
+        )
+        per_layer = (
+            2 * h * (hq * d) + 2 * 2 * h * (hkv * d) + 2 * (hq * d) * h
+            + 3 * 2 * h * inter
+        )
+        return (S * L * per_layer + L * S * 4 * S * hq * d + 2 * h * V) / 1e12
+
+    out = {"model": "llama-3-8b-shape" if on_tpu else "tiny-cpu-fallback",
+           "backend": jax.default_backend(),
+           "scope": "b1 prefill, device time (xplane), shipped defaults"}
+    if on_tpu:
+        out["device"] = str(jax.devices()[0].device_kind)
+    for S in ((128, 512, 2048) if on_tpu else (16,)):
+        T = S + 128
+        cache = QuantizedDenseKVCache.create(
+            cfg.num_layers, 1, T, cfg.num_kv_heads, cfg.head_dim,
+            jnp.bfloat16 if on_tpu else jnp.float32, use_kernel=on_tpu,
+        )
+        num_new = jnp.full((1,), S, jnp.int32)
+
+        @jax.jit
+        def prefill(params, tokens, cache):
+            logits, cache = llama.model_apply(
+                cfg, params, tokens, cache, num_new, head="last"
+            )
+            return jnp.argmax(logits[:, 0], -1)
+
+        jax.block_until_ready(
+            prefill(params, jnp.zeros((1, S), jnp.int32), cache)
+        )
+        dev = _device_time_ms_per_call(
+            lambda i: prefill(
+                params, jnp.full((1, S), (i % 17) + 1, jnp.int32), cache
+            ),
+            reps=3,
+        )
+        if dev:
+            rate = model_tflops(S) / (dev / 1e3)
+            out[f"prompt_{S}"] = {
+                "device_ms": dev, "tflop_s": round(rate, 1),
+                "pct_of_nominal_197": round(100 * rate / 197, 1),
+            }
+        else:
+            out[f"prompt_{S}"] = {"device_ms": None}
+    return out
+
+
 def _distributed_phase() -> dict:
     """Transport-tier benchmark (VERDICT r4 ask 4): relay microbench +
     2-node pipeline tok/s, all on localhost and EXPLICITLY CPU-scope — the
@@ -1258,6 +1322,8 @@ def _distributed_phase() -> dict:
 def run_phase(name: str) -> dict:
     if name == "distributed":
         return _distributed_phase()
+    if name == "prefill":
+        return _prefill_phase()
     on_tpu = jax.default_backend() == "tpu"
     cfg, model_label = _PHASE_CFG.get(name, (LLAMA2_7B, "llama-2-7b-shape"))
     if not on_tpu:
@@ -1381,7 +1447,8 @@ def main():
     # number is measured at acceptance=1.0 by construction and the sink ring
     # reads a bounded window — neither is comparable decode work.
     _NON_HEADLINE = {"speculative", "sink_1k", "llama3_8b_int8_kvq",
-                     "mistral_paged_swa", "mixtral", "distributed"}
+                     "mistral_paged_swa", "mixtral", "distributed",
+                     "prefill"}
     best_dtype = max(
         (n for n in results if n not in _NON_HEADLINE),
         key=lambda n: results[n]["tok_s"],

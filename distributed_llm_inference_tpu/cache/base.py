@@ -55,6 +55,13 @@ def window_ladder(
     return tuple(ws)
 
 
+# Prefills at least this long route the quantized caches' attention through
+# the flash kernel's gather path instead of the int8-score formulation: the
+# materialized [B, Hq, S, T] scores turn dominant around S ~ 1k (measured 8B
+# b1 device: S=512 int8-path 93 ms vs flash 119; S=2048 743 vs 593).
+FLASH_PREFILL_MIN_S = 1024
+
+
 class GatherAttendMixin:
     """Default ``attend``: gather-to-contiguous + ``attention_fn``."""
 

@@ -33,7 +33,7 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
-from .base import GatherAttendMixin
+from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin
 
 
 def _tail_flush_rows(big, tail, lengths, tail_len, axis):
@@ -547,13 +547,28 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         :func:`ops.attention.gqa_attention_quantized` — the dequant-multiply
         formulation materializes bf16 K/V copies each step). A non-default
         ``attention_fn`` (Pallas kernels expect bf16 K/V) falls back to the
-        dequantizing gather path."""
+        dequantizing gather path.
+
+        LONG prefills (S >= ``FLASH_PREFILL_MIN_S``, tiles permitting) also
+        take the gather path — through the flash kernel: the int8-score
+        formulation materializes [B, Hq, S, T] scores in HBM, which turns
+        from noise at S=512 (int8 path 93 ms vs flash 119 for an 8B-shape
+        prefill) into the dominant cost at S=2048 (743 vs 593 ms) — flash's
+        online softmax never materializes them."""
         from ..ops.attention import gqa_attention, gqa_attention_quantized
 
         if attention_fn is not gqa_attention:
             return super().attend(
                 layer_state, q, k_new, v_new, rope, q_pos, num_new,
                 sliding_window, attention_fn, scale,
+            )
+        s, t = q.shape[1], layer_state[0].shape[2]  # head-major: T axis 2
+        if s >= FLASH_PREFILL_MIN_S and s % 128 == 0 and t % 128 == 0:
+            from ..ops.flash_attention import flash_attention
+
+            return super().attend(
+                layer_state, q, k_new, v_new, rope, q_pos, num_new,
+                sliding_window, flash_attention, scale,
             )
         layer_k, layer_v, layer_ks, layer_vs = layer_state
         q_rot = apply_rope(q, rope.cos, rope.sin)

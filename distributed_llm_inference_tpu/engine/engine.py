@@ -333,17 +333,20 @@ class InferenceEngine:
             # bucket shape, not per (row, length) combination.
             sub = cache.select_row(row)
             logits, sub = llama.model_apply(
-                cfg, params, tokens, sub, n_valid[None], **mkw
+                cfg, params, tokens, sub, n_valid[None], head="last", **mkw
             )
             cache = cache.merge_row(sub, row)
-            last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1, keepdims=True)
-            token = sample(last, key, sp)
+            token = sample(logits[:, 0], key, sp)
             return token[0], cache
 
         def _prefill_row_nosample(params, tokens, cache, row, n_valid):
-            """Chunked-prefill body: fill cache, discard logits."""
+            """Chunked-prefill body: fill cache; head skipped entirely
+            (an interior chunk samples nothing — the full-vocab matmul
+            over the chunk was pure waste)."""
             sub = cache.select_row(row)
-            _, sub = llama.model_apply(cfg, params, tokens, sub, n_valid[None], **mkw)
+            _, sub = llama.model_apply(
+                cfg, params, tokens, sub, n_valid[None], head="none", **mkw
+            )
             return cache.merge_row(sub, row)
 
         def _prefill_rows(params, tokens, cache, rows, n_valid, key, sp):
@@ -366,13 +369,10 @@ class InferenceEngine:
             every serving shape tried (b160×T256 included)."""
             sub = cache.select_rows(rows)
             logits, sub = llama.model_apply(
-                cfg, params, tokens, sub, n_valid, **mkw
+                cfg, params, tokens, sub, n_valid, head="last", **mkw
             )
             cache = cache.merge_rows(sub, rows)
-            last = jnp.take_along_axis(
-                logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
-            )[:, 0]
-            toks = sample(last, key, sp)
+            toks = sample(logits[:, 0], key, sp)
             return toks, cache
 
         def _prefill_rows_standalone(params, tokens, sub, n_valid, key, sp):
@@ -382,12 +382,9 @@ class InferenceEngine:
             to gather). Program B (`_merge_rows_only`) scatters the result
             rows into the big cache."""
             logits, sub = llama.model_apply(
-                cfg, params, tokens, sub, n_valid, **mkw
+                cfg, params, tokens, sub, n_valid, head="last", **mkw
             )
-            last = jnp.take_along_axis(
-                logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
-            )[:, 0]
-            toks = sample(last, key, sp)
+            toks = sample(logits[:, 0], key, sp)
             return toks, sub
 
         def _merge_rows_only(cache, sub, rows):
@@ -581,7 +578,9 @@ class InferenceEngine:
 
             def _draft_prefill_row(dp_, tokens, dcache, row, n_valid):
                 sub = dcache.select_row(row)
-                _, sub = llama.model_apply(dcfg, dp_, tokens, sub, n_valid[None])
+                _, sub = llama.model_apply(
+                    dcfg, dp_, tokens, sub, n_valid[None], head="none"
+                )
                 return dcache.merge_row(sub, row)
 
             def _draft_propose(dp_, tokens, dcache, active):

@@ -344,14 +344,60 @@ def quantize_int4(
     )
 
 
+# Prefill calls (>= this many sequence positions) against int8 weights run
+# int8 x int8 on the MXU with dynamic per-token activation scales (AQT
+# style) instead of dequantizing the weight into a bf16 matmul: the int8
+# systolic path has 2x the bf16 peak on v5e, and at prefill row counts the
+# per-token abs-max/round VPU work amortizes. Measured 8B-shape prefill
+# device time (r5, b1): S=512 213 → 93 ms, S=2048 1109 → 743 ms, S=128
+# 42.8 → 39.4 ms. Decode (S == 1) and short verifies keep the weight-only
+# path: they are HBM-bound, and W8A8 would change their numerics for no
+# throughput.
+ACT_QUANT_PREFILL = True
+ACT_QUANT_MIN_SEQ = 128
+
+
+def w8a8_matmul(x: jax.Array, w: QuantizedTensor) -> jax.Array:
+    """int8 x int8 MXU matmul with dynamic symmetric per-token activation
+    scales: ``y = (q_x @ q_w) * x_scale * w_scale``. The int32 accumulator
+    is exact and the scales are applied in f32 BEFORE the cast to the
+    activation dtype (casting the ~1e5-magnitude accumulator to bf16 first
+    would round away ~2^-9 relative); the only additional quantization
+    error vs weight-only int8 is the activations' own rounding — per-token
+    scales keep the combined matmul error ~1% relative
+    (tests/test_quant.py::test_w8a8_matmul_close_to_fp)."""
+    amax = jnp.max(jnp.abs(x).astype(jnp.float32), axis=-1, keepdims=True)
+    xs = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / xs), -127, 127
+    ).astype(jnp.int8)
+    y = jax.lax.dot_general(
+        q, w.q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (
+        y.astype(jnp.float32) * xs * w.scale.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
 def matmul(x: jax.Array, w) -> jax.Array:
     """``x @ w`` that transparently handles quantized weights.
 
     For a :class:`QuantizedTensor`, computes ``(x @ q) * scale`` with the
-    int8→bf16 convert fused into the matmul operand read by XLA. For a
-    :class:`QuantizedTensor4`, per-group partial sums are scaled before the
-    group reduction.
+    int8→bf16 convert fused into the matmul operand read by XLA — except
+    prefill-shaped calls on TPU, which take :func:`w8a8_matmul`'s int8 MXU
+    path (see ``ACT_QUANT_PREFILL``). For a :class:`QuantizedTensor4`,
+    per-group partial sums are scaled before the group reduction.
     """
+    if (
+        ACT_QUANT_PREFILL
+        and isinstance(w, QuantizedTensor)
+        and w.q.ndim == 2
+        and x.ndim >= 3
+        and x.shape[-2] >= ACT_QUANT_MIN_SEQ
+        and jax.default_backend() == "tpu"
+    ):
+        return w8a8_matmul(x, w)
     if isinstance(w, QuantizedTensorOutlier):
         y = (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
         xo = jnp.take(x, w.outlier_idx, axis=-1)

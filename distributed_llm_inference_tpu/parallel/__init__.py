@@ -1,4 +1,5 @@
-from .mesh import build_mesh, named_sharding, single_device_mesh
+from .mesh import (build_mesh, initialize_distributed, named_sharding,
+                   single_device_mesh)
 from .pipeline import pipeline_block_apply, pipelined_model_apply
 from .ring import dense_cache_from_ring, ring_gqa_attention, ring_prefill
 from .tp import (
@@ -11,6 +12,7 @@ from .tp import (
 
 __all__ = [
     "build_mesh",
+    "initialize_distributed",
     "pipeline_block_apply",
     "pipelined_model_apply",
     "dense_cache_from_ring",

@@ -30,7 +30,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import MeshConfig
 
-__all__ = ["build_mesh", "single_device_mesh", "named_sharding"]
+__all__ = [
+    "build_mesh",
+    "initialize_distributed",
+    "single_device_mesh",
+    "named_sharding",
+]
+
+
+def initialize_distributed(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join this process to a multi-host SPMD job (``jax.distributed``).
+
+    The multi-HOST half of the two-tier design (SURVEY §5.8): within one
+    pod slice, N processes (one per host) initialize against a coordinator
+    and ``jax.devices()`` becomes the GLOBAL device list — after which
+    :func:`build_mesh` lays dp/pp/ep/tp/sp over every chip in the slice and
+    XLA compiles the collectives onto ICI/DCN exactly as it does
+    single-host (the role the reference delegated to hivemind's DHT +
+    NCCL process groups and never finished, ``server/backend.py:4-7``).
+    Meshes BIGGER than one slice remain the relay tier's job
+    (``distributed/`` — one engine or node per slice, activations over
+    TCP).
+
+    Call once per process before any other JAX API. On CPU test rigs the
+    same call builds a gloo-backed multi-process platform (see
+    tests/test_multihost.py, which runs a REAL 2-process global mesh).
+    """
+    kwargs = {}
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
 
 
 def build_mesh(

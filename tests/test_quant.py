@@ -547,3 +547,99 @@ def test_engine_int8_outlier_generates_and_tp_shards():
     assert sharded.generate(
         [[1, 2, 3]], SamplingOptions(max_new_tokens=5)
     ) == outs
+
+
+# -- W8A8 prefill path (int8 activations on the MXU) -------------------------
+
+
+def test_w8a8_matmul_close_to_fp():
+    from distributed_llm_inference_tpu.ops.quant import w8a8_matmul
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 64, 128)).astype(np.float32)
+    w = rng.standard_normal((128, 96)).astype(np.float32)
+    exact = x @ w
+    got = np.asarray(w8a8_matmul(
+        jnp.asarray(x), quantize_int8(jnp.asarray(w), jnp.float32)
+    ))
+    err = np.linalg.norm(got - exact) / np.linalg.norm(exact)
+    # int8 weights AND int8 per-token activations: ~1% relative is the
+    # expected regime (weight-only int8 alone is ~0.5%).
+    assert err < 0.02, err
+
+
+def test_w8a8_activation_outlier_rows_keep_their_scale():
+    """Per-token scales: one huge row must not crush the others' precision."""
+    from distributed_llm_inference_tpu.ops.quant import w8a8_matmul
+
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((1, 8, 64)).astype(np.float32)
+    x[0, 3] *= 1000.0
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    exact = x @ w
+    got = np.asarray(w8a8_matmul(
+        jnp.asarray(x), quantize_int8(jnp.asarray(w), jnp.float32)
+    ))
+    for i in range(8):  # every row individually accurate
+        err = np.linalg.norm(got[0, i] - exact[0, i]) / np.linalg.norm(exact[0, i])
+        assert err < 0.02, (i, err)
+
+
+def test_model_apply_head_last_and_none():
+    """head="last" logits equal the full head's last valid position;
+    head="none" returns no logits but the same cache writes."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(7), jnp.float32)
+
+    def cache():
+        return DenseKVCache.create(
+            CFG.num_layers, 2, 32, CFG.num_kv_heads, CFG.head_dim, jnp.float32
+        )
+
+    toks = jnp.asarray([[5, 9, 2, 11], [3, 1, 0, 0]], jnp.int32)
+    n = jnp.asarray([4, 2], jnp.int32)
+    full, c_full = llama.model_apply(CFG, params, toks, cache(), n)
+    last, c_last = llama.model_apply(CFG, params, toks, cache(), n,
+                                     head="last")
+    np.testing.assert_allclose(np.asarray(last[0, 0]), np.asarray(full[0, 3]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(last[1, 0]), np.asarray(full[1, 1]),
+                               rtol=1e-5)
+    none, c_none = llama.model_apply(CFG, params, toks, cache(), n,
+                                     head="none")
+    assert none is None
+    np.testing.assert_array_equal(np.asarray(c_none.k), np.asarray(c_full.k))
+    np.testing.assert_array_equal(np.asarray(c_none.lengths),
+                                  np.asarray(c_full.lengths))
+
+
+def test_quantized_cache_flash_prefill_path_matches_int8_path():
+    """The S >= FLASH_PREFILL_MIN_S dispatch inside the quantized caches'
+    attend: flash-over-dequantized-gather must track the int8-score path
+    closely (same int8 cache contents, different softmax realization)."""
+    from distributed_llm_inference_tpu.cache import dense as dense_mod
+    from distributed_llm_inference_tpu.cache.dense import QuantizedDenseKVCache
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(8), jnp.float32)
+    toks = jnp.asarray(
+        np.random.default_rng(9).integers(0, CFG.vocab_size, (1, 128))
+    )
+    n = jnp.asarray([128], jnp.int32)
+
+    def run():
+        cache = QuantizedDenseKVCache.create(
+            CFG.num_layers, 1, 256, CFG.num_kv_heads, CFG.head_dim,
+            jnp.float32,
+        )
+        logits, _ = llama.model_apply(CFG, params, toks, cache, n,
+                                      head="last")
+        return np.asarray(logits)
+
+    ref = run()  # int8-score path (MIN_S default 1024 > 128)
+    old = dense_mod.FLASH_PREFILL_MIN_S
+    dense_mod.FLASH_PREFILL_MIN_S = 64
+    try:
+        got = run()  # flash path (interpret mode on CPU)
+    finally:
+        dense_mod.FLASH_PREFILL_MIN_S = old
+    err = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert err < 5e-3, err
