@@ -1404,9 +1404,13 @@ def _phase_in_subprocess(name: str) -> dict:
     import subprocess
     import sys
 
+    # The speculative phase measures FIVE acceptance points (p=1/.85/.7/.5/0)
+    # back to back on one engine — ~20 min with compiles; everything else
+    # fits comfortably in 20.
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--phase", name],
-        capture_output=True, text=True, timeout=1200,
+        capture_output=True, text=True,
+        timeout=2700 if name == "speculative" else 1200,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     if out.returncode != 0:

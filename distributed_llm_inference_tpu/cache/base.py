@@ -62,6 +62,25 @@ def window_ladder(
 FLASH_PREFILL_MIN_S = 1024
 
 
+def flash_prefill_fn(s: int, t: int, attention_fn):
+    """The flash-for-long-prefill policy, in ONE place for every quantized
+    cache kind: returns the flash kernel when the caller's default-attention
+    prefill is long enough and tiles cleanly, else None (keep the int8-score
+    path). ``s``/``t`` = query/buffer lengths."""
+    from ..ops.attention import gqa_attention
+
+    if (
+        attention_fn is gqa_attention
+        and s >= FLASH_PREFILL_MIN_S
+        and s % 128 == 0
+        and t % 128 == 0
+    ):
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention
+    return None
+
+
 class GatherAttendMixin:
     """Default ``attend``: gather-to-contiguous + ``attention_fn``."""
 

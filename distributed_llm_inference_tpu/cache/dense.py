@@ -33,7 +33,7 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
-from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin
+from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin, flash_prefill_fn
 
 
 def _tail_flush_rows(big, tail, lengths, tail_len, axis):
@@ -562,13 +562,14 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
                 layer_state, q, k_new, v_new, rope, q_pos, num_new,
                 sliding_window, attention_fn, scale,
             )
-        s, t = q.shape[1], layer_state[0].shape[2]  # head-major: T axis 2
-        if s >= FLASH_PREFILL_MIN_S and s % 128 == 0 and t % 128 == 0:
-            from ..ops.flash_attention import flash_attention
-
+        # head-major layout: T is axis 2 of the per-layer k plane.
+        flash = flash_prefill_fn(
+            q.shape[1], layer_state[0].shape[2], attention_fn
+        )
+        if flash is not None:
             return super().attend(
                 layer_state, q, k_new, v_new, rope, q_pos, num_new,
-                sliding_window, flash_attention, scale,
+                sliding_window, flash, scale,
             )
         layer_k, layer_v, layer_ks, layer_vs = layer_state
         q_rot = apply_rope(q, rope.cos, rope.sin)

@@ -36,7 +36,7 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
-from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin
+from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin, flash_prefill_fn
 
 
 @jax.jit
@@ -674,20 +674,12 @@ class QuantizedPagedKVCache(PagedKVCache):
     def attend(self, layer_state, q, k_new, v_new, rope, q_pos, num_new,
                sliding_window, attention_fn, scale=None):
         if not self.use_kernel or q.shape[1] != 1:
-            from ..ops.attention import gqa_attention
-
-            s = q.shape[1]
-            if (
-                attention_fn is gqa_attention
-                and s >= FLASH_PREFILL_MIN_S
-                and s % 128 == 0
-                and self.max_len % 128 == 0
-            ):
-                # Long prefill: flash over the dequantized pool view (see
-                # cache/dense.py — the full-score path dominates at S >~ 1k).
-                from ..ops.flash_attention import flash_attention
-
-                attention_fn = flash_attention
+            # Long prefill: flash over the dequantized pool view (see
+            # cache/base.py flash_prefill_fn — the full-score path
+            # dominates at S >~ 1k).
+            flash = flash_prefill_fn(q.shape[1], self.max_len, attention_fn)
+            if flash is not None:
+                attention_fn = flash
             return super(PagedKVCache, self).attend(
                 layer_state, q, k_new, v_new, rope, q_pos, num_new,
                 sliding_window, attention_fn, scale,

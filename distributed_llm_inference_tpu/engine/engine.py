@@ -601,7 +601,8 @@ class InferenceEngine:
 
             def _draft_catchup(dp_, tokens, dcache, mask):
                 _, dcache = llama.model_apply(
-                    dcfg, dp_, tokens, dcache, mask.astype(jnp.int32)
+                    dcfg, dp_, tokens, dcache, mask.astype(jnp.int32),
+                    head="none",  # cache ingest only — logits unused
                 )
                 return dcache
 

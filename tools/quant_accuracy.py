@@ -46,6 +46,7 @@ from distributed_llm_inference_tpu.cache.dense import (
 )
 from distributed_llm_inference_tpu.config import ModelConfig
 from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.ops import quant as quant_mod
 from distributed_llm_inference_tpu.ops.quant import quantize_params
 
 SHAPES = {
@@ -235,7 +236,18 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--w8a8", action="store_true",
+                    help="measure the PREFILL path (dynamic per-token int8 "
+                         "activations on the MXU) instead of the decode "
+                         "path's weight-only int8 — the two differ on TPU "
+                         "for S >= %d" % quant_mod.ACT_QUANT_MIN_SEQ)
     args = ap.parse_args(argv)
+    # The harness's teacher-forced full-sequence forward is PREFILL-shaped,
+    # which would silently route int8 layers through the W8A8 MXU path on
+    # TPU; pin the decode (weight-only) semantics unless --w8a8 asked for
+    # the prefill path explicitly, so "int8" numbers keep meaning what the
+    # decode tokens see.
+    quant_mod.ACT_QUANT_PREFILL = bool(args.w8a8)
 
     # The master copy is built ON HOST: at 7B scale the bf16 tree fills
     # most of HBM and even device_get of a resident tree exhausts the
