@@ -33,7 +33,7 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
-from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin, flash_prefill_fn
+from .base import GatherAttendMixin, flash_prefill_fn
 
 
 def _tail_flush_rows(big, tail, lengths, tail_len, axis):

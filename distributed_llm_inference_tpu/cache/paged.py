@@ -36,7 +36,7 @@ from flax import struct
 
 from ..ops.attention import causal_mask
 from ..ops.rotary import RopeAngles, apply_rope
-from .base import FLASH_PREFILL_MIN_S, GatherAttendMixin, flash_prefill_fn
+from .base import GatherAttendMixin, flash_prefill_fn
 
 
 @jax.jit
@@ -57,6 +57,22 @@ def _table_write_batch(table, rows, slots, pages):
     ≈ 1.1 s spikes on the serving tick. One batched executable per padded
     length replaces the chain."""
     return table.at[rows, slots].set(pages, mode="drop")
+
+
+def _page_chunks(a, cap, slots, ps):
+    """Chunk contiguous 1-row ring KV ``[L, 1, S, ...]`` into per-page
+    tiles ``[L, slots, heads, PS(, D)]`` (shared by the bf16 and int8 pool
+    ingests so the layout cannot drift between them)."""
+    a = a[:, 0]
+    s = a.shape[1]
+    if s >= cap:
+        a = jax.lax.slice_in_dim(a, 0, cap, axis=1)
+    else:
+        widths = [(0, 0)] * a.ndim
+        widths[1] = (0, cap - s)
+        a = jnp.pad(a, widths)
+    a = a.reshape(a.shape[0], slots, ps, *a.shape[2:])
+    return jnp.swapaxes(a, 2, 3)
 
 
 class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
@@ -389,6 +405,31 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             **updated,
         )
 
+    def ingest_row(self, ks, vs, n_valid):
+        """Install ring-prefill KV into the page pool (cf.
+        ``DenseKVCache.ingest_row``; 1-row ``select_row`` view — the pool
+        is SHARED, so the pages land in place and ``merge_row`` writes the
+        table/length back): the contiguous ``[L, 1, S, Hkv, D]`` ring KV is
+        chunked into page-size pieces and scattered to this row's table
+        slots. Slots past the assigned run hold the null page; their junk
+        writes are never read (validity derives from ``lengths``), and
+        duplicate null-page indices are harmless for the same reason."""
+        ps = self.page_size
+        slots = self.page_table.shape[1]
+        chunk = lambda a: _page_chunks(a, slots * ps, slots, ps)
+        pages = self.page_table[0]
+        return self.replace(
+            k_pages=self.k_pages.at[:, pages].set(
+                chunk(ks).astype(self.k_pages.dtype)
+            ),
+            v_pages=self.v_pages.at[:, pages].set(
+                chunk(vs).astype(self.v_pages.dtype)
+            ),
+            lengths=jnp.broadcast_to(
+                jnp.asarray(n_valid, jnp.int32), self.lengths.shape
+            ),
+        )
+
     def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
         """Host-side helper: install allocator-chosen page ids for a row.
 
@@ -606,6 +647,35 @@ class QuantizedPagedKVCache(PagedKVCache):
             ),
             lengths=jax.lax.dynamic_update_slice_in_dim(
                 self.lengths, sub.lengths, row, axis=0
+            ),
+        )
+
+    def ingest_row(self, ks, vs, n_valid):
+        """Ring-prefill ingest, quantized pool form: per-(token, head)
+        int8 + scale planes (cf. ``QuantizedDenseKVCache.ingest_row``)."""
+        from .dense import _quantize_kv
+
+        k_q, k_s = _quantize_kv(ks)  # [L, 1, S, H, D] / [L, 1, S, H]
+        v_q, v_s = _quantize_kv(vs)
+        ps = self.page_size
+        slots = self.page_table.shape[1]
+        chunk = lambda a: _page_chunks(a, slots * ps, slots, ps)
+        pages = self.page_table[0]
+        return self.replace(
+            k_pages=self.k_pages.at[:, pages].set(
+                chunk(k_q).astype(self.k_pages.dtype)
+            ),
+            v_pages=self.v_pages.at[:, pages].set(
+                chunk(v_q).astype(self.v_pages.dtype)
+            ),
+            ks_pages=self.ks_pages.at[:, pages].set(
+                chunk(k_s).astype(self.ks_pages.dtype)
+            ),
+            vs_pages=self.vs_pages.at[:, pages].set(
+                chunk(v_s).astype(self.vs_pages.dtype)
+            ),
+            lengths=jnp.broadcast_to(
+                jnp.asarray(n_valid, jnp.int32), self.lengths.shape
             ),
         )
 

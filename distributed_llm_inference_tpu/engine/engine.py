@@ -248,10 +248,11 @@ class InferenceEngine:
                         "sp>1 ring prefill does not compose with pp serving "
                         f"(got {mesh_cfg})"
                     )
-                if cc.kind != "dense":
+                if cc.kind not in ("dense", "paged"):
                     raise ValueError(
-                        "sp>1 ring prefill requires a dense cache kind (it "
-                        f"ingests contiguous ring KV; got kind={cc.kind!r})"
+                        "sp>1 ring prefill requires a dense or paged cache "
+                        "kind (contiguous ring KV ingest; the sink ring "
+                        f"evicts on write; got kind={cc.kind!r})"
                     )
             if mesh_cfg.pp > 1 and cc.kind not in ("dense", "paged"):
                 # Paged composes: the pool's layer axis leads every array, so
