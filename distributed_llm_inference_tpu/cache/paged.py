@@ -414,20 +414,35 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         slots. Slots past the assigned run hold the null page; their junk
         writes are never read (validity derives from ``lengths``), and
         duplicate null-page indices are harmless for the same reason."""
+        return self._ingest_planes({"k_pages": ks, "v_pages": vs}, n_valid)
+
+    def _ingest_planes(self, planes, n_valid):
+        """Shared ring-ingest write pattern (bf16 values and int8+scale
+        planes alike): chunk each contiguous plane into page tiles and
+        scatter to this row's table slots, then set lengths. Batch-1 views
+        ONLY — a multi-row cache would broadcast ``n_valid`` into rows
+        whose pages received nothing (silent corruption), so fail loudly."""
+        if self.lengths.shape[0] != 1:
+            raise ValueError(
+                "paged ingest_row needs a batch-1 select_row view, got "
+                f"batch {self.lengths.shape[0]}"
+            )
         ps = self.page_size
         slots = self.page_table.shape[1]
-        chunk = lambda a: _page_chunks(a, slots * ps, slots, ps)
         pages = self.page_table[0]
+        updates = {
+            name: getattr(self, name).at[:, pages].set(
+                _page_chunks(a, slots * ps, slots, ps).astype(
+                    getattr(self, name).dtype
+                )
+            )
+            for name, a in planes.items()
+        }
         return self.replace(
-            k_pages=self.k_pages.at[:, pages].set(
-                chunk(ks).astype(self.k_pages.dtype)
-            ),
-            v_pages=self.v_pages.at[:, pages].set(
-                chunk(vs).astype(self.v_pages.dtype)
-            ),
             lengths=jnp.broadcast_to(
                 jnp.asarray(n_valid, jnp.int32), self.lengths.shape
             ),
+            **updates,
         )
 
     def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
@@ -657,26 +672,10 @@ class QuantizedPagedKVCache(PagedKVCache):
 
         k_q, k_s = _quantize_kv(ks)  # [L, 1, S, H, D] / [L, 1, S, H]
         v_q, v_s = _quantize_kv(vs)
-        ps = self.page_size
-        slots = self.page_table.shape[1]
-        chunk = lambda a: _page_chunks(a, slots * ps, slots, ps)
-        pages = self.page_table[0]
-        return self.replace(
-            k_pages=self.k_pages.at[:, pages].set(
-                chunk(k_q).astype(self.k_pages.dtype)
-            ),
-            v_pages=self.v_pages.at[:, pages].set(
-                chunk(v_q).astype(self.v_pages.dtype)
-            ),
-            ks_pages=self.ks_pages.at[:, pages].set(
-                chunk(k_s).astype(self.ks_pages.dtype)
-            ),
-            vs_pages=self.vs_pages.at[:, pages].set(
-                chunk(v_s).astype(self.vs_pages.dtype)
-            ),
-            lengths=jnp.broadcast_to(
-                jnp.asarray(n_valid, jnp.int32), self.lengths.shape
-            ),
+        return self._ingest_planes(
+            {"k_pages": k_q, "v_pages": v_q,
+             "ks_pages": k_s, "vs_pages": v_s},
+            n_valid,
         )
 
     def _scatter_q(self, layer_k, layer_v, layer_ks, layer_vs, k_rot, v_new,

@@ -253,10 +253,10 @@ def test_engine_tp_mesh_matches_single_device():
 def test_engine_mesh_rejects_bad_configs():
     from distributed_llm_inference_tpu.config import MeshConfig
 
-    with pytest.raises(ValueError):  # ring prefill needs a dense cache kind
-        InferenceEngine(
+    with pytest.raises(ValueError):  # ring prefill: dense/paged only (the
+        InferenceEngine(                 # sink ring evicts on write)
             CFG, PARAMS, EngineConfig(max_batch_size=2, dtype="float32"),
-            CacheConfig(kind="paged"), mesh_cfg=MeshConfig(sp=2),
+            CacheConfig(kind="sink"), mesh_cfg=MeshConfig(sp=2),
         )
     with pytest.raises(ValueError):  # sp does not compose with pp serving
         InferenceEngine(

@@ -616,7 +616,7 @@ def test_quantized_cache_flash_prefill_path_matches_int8_path():
     """The S >= FLASH_PREFILL_MIN_S dispatch inside the quantized caches'
     attend: flash-over-dequantized-gather must track the int8-score path
     closely (same int8 cache contents, different softmax realization)."""
-    from distributed_llm_inference_tpu.cache import dense as dense_mod
+    from distributed_llm_inference_tpu.cache import base as cache_base
     from distributed_llm_inference_tpu.cache.dense import QuantizedDenseKVCache
 
     params = llama.init_params(CFG, jax.random.PRNGKey(8), jnp.float32)
@@ -635,11 +635,11 @@ def test_quantized_cache_flash_prefill_path_matches_int8_path():
         return np.asarray(logits)
 
     ref = run()  # int8-score path (MIN_S default 1024 > 128)
-    old = dense_mod.FLASH_PREFILL_MIN_S
-    dense_mod.FLASH_PREFILL_MIN_S = 64
+    old = cache_base.FLASH_PREFILL_MIN_S
+    cache_base.FLASH_PREFILL_MIN_S = 64  # the policy reads this at call time
     try:
         got = run()  # flash path (interpret mode on CPU)
     finally:
-        dense_mod.FLASH_PREFILL_MIN_S = old
+        cache_base.FLASH_PREFILL_MIN_S = old
     err = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert err < 5e-3, err
