@@ -222,3 +222,45 @@ def test_engine_mesh_kernel_matches_mesh_xla():
         return eng.generate(prompts, opts)
 
     assert run(True) == run(False)
+
+
+def test_engine_sink_tp_mesh_sane_and_variants_agree():
+    """Sink-cache serving under a tp mesh (the cache_pspecs rows for the
+    rings landed in r5). Mesh-vs-solo greedy tokens can drift from psum
+    reassociation near-ties (see test_engine_mesh_kernel_matches_mesh_xla),
+    so the assertions are drift-tolerant: full stream lengths past the
+    window (the ring served every step), high solo agreement (a sharding
+    bug — scrambled heads, wrong ring slots — produces near-zero
+    agreement, not a near-tie flip), and bf16-vs-int8 ring agreement on
+    the SAME mesh."""
+    from distributed_llm_inference_tpu.config import MeshConfig
+
+    params = _params()
+    rng = np.random.default_rng(31)
+    ps = [rng.integers(0, CFG.vocab_size, size=n).tolist() for n in (5, 9)]
+    opts = SamplingOptions(max_new_tokens=24)  # streams past window=16
+
+    def run(mesh_cfg, kv_quant):
+        eng = InferenceEngine(
+            CFG, params,
+            EngineConfig(max_batch_size=2, prefill_buckets=(8, 16),
+                         max_seq_len=64, dtype="float32"),
+            CacheConfig(kind="sink", kv_quant=kv_quant, window_length=16,
+                        num_sink_tokens=2),
+            mesh_cfg=mesh_cfg,
+        )
+        return eng.generate(ps, opts)
+
+    def agreement(a, b):
+        n = sum(len(x) for x in a)
+        same = sum(
+            int(x == y) for ra, rb in zip(a, b) for x, y in zip(ra, rb)
+        )
+        return same / n
+
+    for kv_quant in (None, "int8"):
+        mesh_out = run(MeshConfig(tp=2), kv_quant)
+        solo_out = run(None, kv_quant)
+        assert [len(o) for o in mesh_out] == [24, 24], kv_quant
+        assert agreement(mesh_out, solo_out) >= 0.8, (kv_quant, mesh_out,
+                                                      solo_out)
