@@ -1,4 +1,6 @@
-"""``python -m distributed_llm_inference_tpu`` → the ``distribute`` CLI."""
+"""``python -m distributed_llm_inference_tpu`` → the ``distribute`` CLI
+(subcommands: relay / serve / generate / local / api / info — ``api`` is
+the OpenAI-compatible HTTP gateway; see ``cli.py``)."""
 
 import sys
 

@@ -429,7 +429,14 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             )
         ps = self.page_size
         slots = self.page_table.shape[1]
-        pages = self.page_table[0]
+        # Scatter ONLY the first ceil(n_valid/page_size) slots — the run this
+        # ingest actually owns. Slots past it are diverted to the null page
+        # (page 0): today they hold the null page anyway, but a future caller
+        # with shared prefix pages still mapped there would otherwise get
+        # them silently overwritten with ring junk.
+        n_owned = (jnp.asarray(n_valid, jnp.int32) + ps - 1) // ps
+        owned = jnp.arange(slots, dtype=jnp.int32) < n_owned
+        pages = jnp.where(owned, self.page_table[0], 0)
         updates = {
             name: getattr(self, name).at[:, pages].set(
                 _page_chunks(a, slots * ps, slots, ps).astype(

@@ -9,6 +9,9 @@ Subcommands map onto the deployment roles:
 * ``generate``  client: route a prompt through the registered nodes
 * ``local``     single-host serving: load a checkpoint into the continuous-
                 batching engine and generate (no relay needed)
+* ``api``       HTTP gateway: OpenAI-compatible ``/v1/completions`` (JSON +
+                SSE streaming) over the local engine, or over the relay
+                chain with ``--relay``; ``/metrics`` + ``/healthz`` included
 * ``info``      inspect a checkpoint (config, layer count, shard files)
 
 Examples::
@@ -18,6 +21,8 @@ Examples::
     distribute serve --model /ckpt/llama --layers 16:32 --relay :18900
     distribute generate --model /ckpt/llama --relay :18900 --prompt-ids 1,2,3
     distribute local --model /ckpt/llama --prompt-ids 1,2,3 --max-new 32
+    distribute api --model /ckpt/llama --port 8000
+    distribute api --model /ckpt/llama --port 8000 --relay :18900
 """
 
 from __future__ import annotations
@@ -286,6 +291,67 @@ def cmd_local(args) -> int:
     return 0
 
 
+def cmd_api(args) -> int:
+    import jax.numpy as jnp
+
+    from .config import CacheConfig, EngineConfig, ServingConfig
+    from .serving import ApiServer, ClientBackend, EngineBackend
+    from .utils import checkpoint
+
+    tokenizer = None
+    if args.tokenizer:
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+        except Exception as e:
+            raise SystemExit(
+                f"--tokenizer {args.tokenizer!r} failed to load: {e}"
+            )
+    resolve, _ = _model_source(args)
+    cfg = checkpoint.load_config(args.model, resolve=resolve)
+    scfg = ServingConfig(
+        host=args.host, port=args.port,
+        max_queue_depth=args.max_queue_depth,
+        default_timeout_s=args.timeout,
+        drain_timeout_s=args.drain_timeout,
+        model_name=args.model,
+    )
+    if args.relay:
+        from .distributed.client import DistributedClient
+
+        host, port = _parse_relay(args.relay)
+        params = checkpoint.load_client_params(
+            args.model, cfg, jnp.dtype(args.dtype), resolve=resolve
+        )
+        client = DistributedClient(
+            port, cfg, params, host=host, dtype=jnp.dtype(args.dtype)
+        )
+        backend = ClientBackend(client, request_timeout_s=args.timeout)
+    else:
+        from .engine.engine import InferenceEngine
+
+        params = checkpoint.load_model_params(
+            args.model, cfg, jnp.dtype(args.dtype), resolve=resolve,
+            cache_dir=args.weights_cache,
+        )
+        engine = InferenceEngine(
+            cfg, params,
+            EngineConfig(
+                max_batch_size=args.max_sessions,
+                max_seq_len=args.max_seq_len, dtype=args.dtype,
+                quantization=args.quantize,
+            ),
+            CacheConfig(kind=args.cache, kv_quant=args.kv_quant),
+        )
+        backend = EngineBackend(engine, idle_sleep_s=scfg.idle_sleep_s)
+    server = ApiServer(backend, scfg, tokenizer=tokenizer)
+    server.serve_forever(ready_cb=lambda port: print(
+        json.dumps({"event": "api_up", "port": port}), flush=True
+    ))
+    return 0
+
+
 def cmd_info(args) -> int:
     from .models import registry
     from .utils import checkpoint
@@ -410,6 +476,42 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dump a jax.profiler device trace + host span "
                         "timeline (Perfetto-loadable) into this directory")
     l.set_defaults(fn=cmd_local)
+
+    a = sub.add_parser(
+        "api",
+        help="HTTP gateway: OpenAI-compatible /v1/completions (+SSE), "
+             "/metrics, /healthz",
+    )
+    a.add_argument("--model", required=True)
+    a.add_argument("--host", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=8000,
+                   help="0 = ephemeral (bound port printed in api_up)")
+    a.add_argument("--relay", default=None,
+                   help="host:port of a relay: serve through the "
+                        "distributed chain instead of a local engine")
+    a.add_argument("--tokenizer", default=None,
+                   help="tokenizer checkpoint dir: enables string prompts "
+                        "and decoded text in responses")
+    a.add_argument("--max-queue-depth", type=int, default=64,
+                   help="gateway-in-flight bound; beyond it requests get "
+                        "429 + Retry-After")
+    a.add_argument("--timeout", type=float, default=120.0,
+                   help="default per-request deadline seconds (body "
+                        "timeout_s overrides)")
+    a.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="SIGTERM drain budget before in-flight requests "
+                        "are cancelled")
+    a.add_argument("--max-sessions", type=int, default=8)
+    a.add_argument("--max-seq-len", type=int, default=2048)
+    a.add_argument("--dtype", default="bfloat16")
+    a.add_argument("--cache", default="paged",
+                   choices=("paged", "dense", "sink"))
+    a.add_argument("--kv-quant", default=None, choices=("int8",))
+    a.add_argument("--quantize", default=None,
+                   choices=("int8", "int4", "int8_outlier"))
+    a.add_argument("--weights-cache", default=None,
+                   help="directory for pre-converted weight caching")
+    a.set_defaults(fn=cmd_api)
 
     i = sub.add_parser("info", help="inspect a checkpoint")
     i.add_argument("--model", required=True)

@@ -238,3 +238,55 @@ class EngineConfig:
     # budget divided by k+1 proposals per round (>=1); 1 recovers
     # per-round dispatch.
     speculative_rounds: Optional[int] = None
+    # W8A8 prefill-activation quantization pins (ops/quant.py's
+    # ACT_QUANT_PREFILL / ACT_QUANT_MIN_SEQ dispatch flags). None = keep the
+    # library defaults (ON past 128 positions on TPU); False / an int pin
+    # the policy for this deployment — act_quant_prefill=False serves
+    # bit-exact weight-only int8 prefill numerics. Applied to the
+    # process-wide flags at engine construction (jit traces capture them at
+    # trace time), so in a multi-engine process the last-constructed engine
+    # wins — one engine per serving process is the deployment shape this
+    # pins.
+    act_quant_prefill: Optional[bool] = None
+    act_quant_min_seq: Optional[int] = None
+    # quantization="int8_outlier": fp input channels carried beside the int8
+    # body per projection (LLM.int8()-inspired decomposition), and optional
+    # calibration activation absmax per weight name ({"wq": [..., in], ...})
+    # steering the channel choice the way LLM.int8() does — without it the
+    # proxy is weight-row energy. A pytree-of-arrays field: excluded from
+    # hashing/eq so EngineConfig stays hashable.
+    outlier_channels: int = 32
+    act_scales: Optional[Any] = dataclasses.field(
+        default=None, hash=False, compare=False
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """HTTP gateway policy (``serving/server.py``): admission control,
+    per-request deadlines, and graceful drain for the OpenAI-compatible
+    ``/v1/completions`` front door."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000  # 0 = ephemeral (the bound port is reported after bind)
+    # Admission bound: completions in flight through the gateway (waiting in
+    # the engine queue + decoding). At the bound new requests get 429 with
+    # a Retry-After header — backpressure a load balancer can act on —
+    # instead of growing an unbounded queue.
+    max_queue_depth: int = 64
+    retry_after_s: float = 1.0
+    # Per-request deadline (seconds): the request body's "timeout_s"
+    # overrides the default, capped at the max. An expired deadline cancels
+    # the underlying generation (engine.cancel) so abandoned requests stop
+    # burning decode slots.
+    default_timeout_s: float = 120.0
+    max_timeout_s: float = 600.0
+    # Cap on a request's max_tokens (an unbounded ask pins a decode slot).
+    max_tokens_cap: int = 2048
+    # Graceful drain (SIGTERM): stop admitting, give in-flight requests this
+    # long to finish, cancel the rest, then exit.
+    drain_timeout_s: float = 30.0
+    # Driver-loop sleep when the engine has no work (seconds).
+    idle_sleep_s: float = 0.002
+    # Reported as the OpenAI "model" field in responses.
+    model_name: str = "distributed-llm-inference-tpu"

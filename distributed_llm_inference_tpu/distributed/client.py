@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -173,6 +173,8 @@ class DistributedClient:
         reroute_wait: float = 15.0,
         options: Optional[SamplingOptions] = None,
         seed: int = 0,
+        on_token: Optional[Callable[[int], None]] = None,
+        stop_check: Optional[Callable[[], bool]] = None,
     ) -> List[int]:
         """Decode one prompt through the remote chain. Thread-safe: each
         call owns its relay connection and reply queue, so N generations may
@@ -189,6 +191,12 @@ class DistributedClient:
         ``prompt + tokens so far`` under a fresh ``generation_id`` (the
         replayed prefix is preserved verbatim; the continuation resumes the
         same keyed sampling stream).
+
+        ``on_token`` (the HTTP gateway's streaming hook) is called once per
+        FRESH token, in order — a failover replay re-feeds cached tokens
+        without re-emitting them. ``stop_check`` is polled between decode
+        hops and before each retry; returning True abandons the generation
+        (tokens so far are returned) — the gateway's cancel/deadline path.
         """
         if not len(prompt):
             raise ValueError("empty prompt")
@@ -207,7 +215,7 @@ class DistributedClient:
                 relay = RelayClient(self.host, self.relay_port)
                 return self._generate_attempt(
                     relay, list(prompt), out, max_new_tokens, eos_token_id,
-                    timeout, opts, key,
+                    timeout, opts, key, on_token, stop_check,
                 )
             except (TimeoutError, RuntimeError, ConnectionError, OSError) as e:
                 # Besides timeouts and worker errors, a relay/control-plane
@@ -219,6 +227,8 @@ class DistributedClient:
                 self.failovers += 1
                 if failures > max_retries:
                     raise
+                if stop_check is not None and stop_check():
+                    return out  # caller abandoned it: don't wait for a route
                 self._await_route(time.monotonic() + reroute_wait)
             finally:
                 if relay is not None:
@@ -260,7 +270,7 @@ class DistributedClient:
 
     def _generate_attempt(
         self, relay, prompt, out: List[int], max_new_tokens, eos_token_id,
-        timeout, opts, key,
+        timeout, opts, key, on_token=None, stop_check=None,
     ) -> List[int]:
         """One route's worth of progress; ``out`` persists across attempts."""
         if out and (len(out) >= max_new_tokens or out[-1] == eos_token_id):
@@ -284,10 +294,14 @@ class DistributedClient:
             else:
                 token = self._next_token(y, last_n - 1, opts, key, 0)
                 out.append(token)
+                if on_token is not None:
+                    on_token(token)
             # Decode loop: one hidden-state hop per token. The sampling key
             # folds in the token INDEX, so a replayed attempt continues the
             # same stream rather than restarting it.
             while len(out) < max_new_tokens and token != eos_token_id:
+                if stop_check is not None and stop_check():
+                    return out
                 x = self._embed(
                     self.params["embed"], jnp.asarray([[token]], jnp.int32)
                 )
@@ -295,6 +309,8 @@ class DistributedClient:
                                        1, timeout, reply_queue)
                 token = self._next_token(y, 0, opts, key, len(out))
                 out.append(token)
+                if on_token is not None:
+                    on_token(token)
             return out
         finally:
             self._end_session(relay, route, gen_id)

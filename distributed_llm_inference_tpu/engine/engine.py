@@ -83,15 +83,34 @@ class InferenceEngine:
         self.cfg = cfg
         self._mesh_cfg = mesh_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        if (
+            self.ecfg.act_quant_prefill is not None
+            or self.ecfg.act_quant_min_seq is not None
+        ):
+            # Pin the W8A8 prefill-activation policy for this deployment
+            # (the flags live at module scope because jitted matmuls capture
+            # them at trace time; EngineConfig is the supported way to set
+            # them — see config.py).
+            from ..ops import quant as _quant
+
+            if self.ecfg.act_quant_prefill is not None:
+                _quant.ACT_QUANT_PREFILL = self.ecfg.act_quant_prefill
+            if self.ecfg.act_quant_min_seq is not None:
+                _quant.ACT_QUANT_MIN_SEQ = self.ecfg.act_quant_min_seq
         if self.ecfg.quantization in ("int8", "int4", "int8_outlier"):
             from ..ops.quant import quantize_params
 
             qkw = {}
             if self.ecfg.quantization == "int8_outlier":
-                # LLM.int8()-style decomposition (the reference's
-                # bitsandbytes threshold=5.0 capability): 32 fp input
-                # channels per projection ride a side matmul.
-                qkw["outlier_channels"] = 32
+                # LLM.int8()-inspired decomposition: fp input channels per
+                # projection ride a side matmul. APPROXIMATES (does not yet
+                # reproduce) bitsandbytes threshold=5.0 — channel choice is
+                # steered by calibration activation absmax when
+                # EngineConfig.act_scales is provided, else by weight-row
+                # energy as a proxy.
+                qkw["outlier_channels"] = self.ecfg.outlier_channels
+                if self.ecfg.act_scales is not None:
+                    qkw["act_scales"] = self.ecfg.act_scales
             if self.ecfg.quantization == "int4":
                 # Unsharded (or dp/ep-only) serving decodes through the
                 # Pallas half-split kernel; tp/pp meshes keep the grouped
@@ -1022,11 +1041,20 @@ class InferenceEngine:
 
     # -- public API -----------------------------------------------------------
 
-    def submit(self, prompt: Sequence[int], options: Optional[SamplingOptions] = None) -> str:
-        """Queue a prompt; returns its generation_id. Thread-safe."""
-        return self._submit_session(prompt, options).generation_id
+    def submit(
+        self,
+        prompt: Sequence[int],
+        options: Optional[SamplingOptions] = None,
+        deadline: Optional[float] = None,
+    ) -> str:
+        """Queue a prompt; returns its generation_id. Thread-safe.
 
-    def _submit_session(self, prompt, options) -> Session:
+        ``deadline`` is an absolute ``time.monotonic()`` instant: past it the
+        scheduler reaps the session like a cancel (finish_reason
+        ``"deadline"``), whether it is still queued or actively decoding."""
+        return self._submit_session(prompt, options, deadline).generation_id
+
+    def _submit_session(self, prompt, options, deadline=None) -> Session:
         # Lock-free on purpose: step() holds the scheduler lock across whole
         # device steps (hundreds of ms at 7B shapes), and request-handler
         # threads must not stall on it. deque.append and dict insertion are
@@ -1034,7 +1062,11 @@ class InferenceEngine:
         # admission pass.
         if len(prompt) == 0:
             raise ValueError("empty prompt")
-        s = Session(prompt=list(prompt), options=options or SamplingOptions())
+        s = Session(
+            prompt=list(prompt),
+            options=options or SamplingOptions(),
+            deadline=deadline,
+        )
         self.sessions[s.generation_id] = s
         self.waiting.append(s)
         self.metrics.counter("sessions_submitted")
@@ -1091,6 +1123,16 @@ class InferenceEngine:
                 or self._pending is not None
                 or getattr(self, "_spec_pending", None) is not None
             )
+
+    def active_sessions(self) -> int:
+        """Resident (decoding) sessions. Lock-free snapshot for
+        observability — a concurrent tick may shift it by the time the
+        caller reads it."""
+        return sum(1 for g in self.slots if g is not None)
+
+    def queue_depth(self) -> int:
+        """Sessions waiting for a slot. Lock-free snapshot."""
+        return len(self.waiting)
 
     def generate(
         self,
@@ -1192,28 +1234,51 @@ class InferenceEngine:
         # Installs queued by a tick that ended up dispatching nothing must
         # land before _shrink_if_idle can rebuild (and re-shape) the table.
         self._flush_installs()
-        # Reap sessions cancelled since the last tick (cancel() is
-        # non-blocking and only sets the flag).
+        # Reap sessions cancelled or deadline-expired since the last tick
+        # (cancel() is non-blocking and only sets the flag; deadlines are
+        # observed here, at tick boundaries). Each reap emits a terminal
+        # ``(gid, -1, True)`` event so streaming consumers (the HTTP
+        # gateway) see every stream end.
+        now = time.monotonic()
         for slot, gid in enumerate(self.slots):
             if gid is None:
                 continue
             s = self.sessions[gid]
-            if s.cancel_requested and s.slot is not None:
+            expired = (
+                not s.cancel_requested
+                and s.deadline is not None
+                and now >= s.deadline
+            )
+            if (s.cancel_requested or expired) and s.slot is not None:
                 s.state = SessionState.CANCELLED
-                s.finish_reason = "cancelled"
+                s.finish_reason = "deadline" if expired else "cancelled"
+                if expired:
+                    self.metrics.counter("sessions_deadline_expired")
                 self._release(s)
+                produced.append((gid, -1, True))
         self._shrink_if_idle()
         admitted: List[Tuple[Session, int]] = []
         for slot in range(self.batch):
             if self.slots[slot] is not None:
                 continue
-            # Drain cancelled entries at the queue head WITHOUT advancing
-            # past this free slot — a real session behind them must not wait
-            # an extra tick per cancelled entry.
-            while self.waiting and self.waiting[0].cancel_requested:
+            # Drain cancelled/expired entries at the queue head WITHOUT
+            # advancing past this free slot — a real session behind them
+            # must not wait an extra tick per cancelled entry.
+            while self.waiting and (
+                self.waiting[0].cancel_requested
+                or (
+                    self.waiting[0].deadline is not None
+                    and now >= self.waiting[0].deadline
+                )
+            ):
                 dropped = self.waiting.popleft()
                 dropped.state = SessionState.CANCELLED
-                dropped.finish_reason = "cancelled"
+                if dropped.cancel_requested:
+                    dropped.finish_reason = "cancelled"
+                else:
+                    dropped.finish_reason = "deadline"
+                    self.metrics.counter("sessions_deadline_expired")
+                produced.append((dropped.generation_id, -1, True))
             if not self.waiting:
                 continue
             s = self.waiting[0]

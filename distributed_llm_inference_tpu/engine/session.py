@@ -41,9 +41,14 @@ class Session:
     # WAITING→ACTIVE transition mid-admission.
     cancel_requested: bool = False
     slot: Optional[int] = None
+    # Absolute time.monotonic() budget: past it the scheduler reaps the
+    # session at the next tick boundary exactly like a cancel (the serving
+    # gateway's per-request deadline — abandoned requests must not keep
+    # burning decode slots). None = no deadline.
+    deadline: Optional[float] = None
     pages: List[int] = dataclasses.field(default_factory=list)
     generated: List[int] = dataclasses.field(default_factory=list)
-    finish_reason: Optional[str] = None  # "eos" | "length" | "capacity" | "cancelled"
+    finish_reason: Optional[str] = None  # "eos" | "length" | "capacity" | "cancelled" | "deadline"
     # Memoized prompt-prefix chain keys (prefix caching; computed once even
     # when pool pressure re-runs admission over many ticks).
     prefix_keys: Optional[List[bytes]] = None

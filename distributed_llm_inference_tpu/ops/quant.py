@@ -134,6 +134,9 @@ def quantize_int8_outlier(
         if act_scales is not None
         else jnp.max(jnp.abs(wf), axis=-1)
     )  # [..., in]
+    # A shared per-channel calibration vector ([in]) broadcasts across a
+    # stacked projection's lead (layer) axes.
+    score = jnp.broadcast_to(jnp.asarray(score), (*lead, in_dim))
     _, idx = jax.lax.top_k(score, k)  # [..., k]
     outlier_w = jnp.take_along_axis(wf, idx[..., None], axis=-2)
     mask = jnp.any(

@@ -1,0 +1,26 @@
+"""HTTP serving gateway (the tier the reference left as pseudocode).
+
+An OpenAI-compatible ``/v1/completions`` front door — JSON and SSE token
+streaming — over either a local :class:`~..engine.engine.InferenceEngine`
+or a relay-tier :class:`~..distributed.client.DistributedClient`, behind
+the common :class:`Backend` protocol. Stdlib-only: raw
+``asyncio.start_server`` HTTP/1.1, one request per connection.
+
+Admission control (bounded in-flight, 429 + ``Retry-After``), per-request
+deadlines that cancel the underlying generation, graceful SIGTERM drain,
+``/metrics`` (Prometheus text) and ``/healthz`` — see
+:class:`~..config.ServingConfig` for the policy knobs and the README
+"HTTP serving" section for the curl quickstart.
+"""
+
+from .backends import Backend, ClientBackend, EngineBackend, Handle, TokenEvent
+from .server import ApiServer
+
+__all__ = [
+    "ApiServer",
+    "Backend",
+    "ClientBackend",
+    "EngineBackend",
+    "Handle",
+    "TokenEvent",
+]

@@ -1,0 +1,261 @@
+"""Generation backends behind the HTTP gateway.
+
+One protocol, two implementations:
+
+* :class:`EngineBackend` — a local :class:`InferenceEngine`. A single
+  driver thread owns ``engine.step()`` (the engine's contract: submit and
+  cancel are thread-safe, ``step`` must stay single-caller) and fans
+  per-token events out to per-request asyncio queues via
+  ``loop.call_soon_threadsafe``.
+* :class:`ClientBackend` — the relay-tier :class:`DistributedClient`.
+  Each request runs ``client.generate`` on its own thread (the client is
+  thread-safe per-call) with the streaming/cancel hooks.
+
+Both expose the same surface the server consumes: ``start(loop)``,
+``submit(prompt, options, deadline) -> Handle``, ``cancel(handle)``,
+``active_sessions()``, ``queue_depth()``, ``stop()``, ``.metrics``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..engine.sampling import SamplingOptions
+from ..utils.metrics import Metrics
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One item on a request's stream queue. ``token == -1`` with
+    ``finished`` means the stream ended without a new token (cancel,
+    deadline, capacity)."""
+
+    token: int
+    finished: bool
+    finish_reason: Optional[str] = None
+
+
+@dataclasses.dataclass(eq=False)  # identity-hashed: handles live in sets
+class Handle:
+    gen_id: str
+    queue: "asyncio.Queue[TokenEvent]"
+    # ClientBackend's cancel signal (EngineBackend cancels via the engine).
+    stop: Optional[threading.Event] = None
+
+
+class Backend:
+    """Interface contract (duck-typed; this base just documents it)."""
+
+    metrics: Metrics
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        raise NotImplementedError
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        options: SamplingOptions,
+        deadline: Optional[float],
+    ) -> Handle:
+        raise NotImplementedError
+
+    def cancel(self, handle: Handle) -> None:
+        raise NotImplementedError
+
+    def active_sessions(self) -> int:
+        raise NotImplementedError
+
+    def queue_depth(self) -> int:
+        raise NotImplementedError
+
+    def stop(self, timeout: float = 10.0) -> None:
+        raise NotImplementedError
+
+
+class EngineBackend(Backend):
+    """Local-engine backend: one driver thread steps the scheduler."""
+
+    def __init__(self, engine, idle_sleep_s: float = 0.002):
+        self.engine = engine
+        self.metrics = engine.metrics  # one /metrics covers engine + gateway
+        self._idle_sleep_s = idle_sleep_s
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._handles: Dict[str, Handle] = {}
+        # Held across engine.submit + handle registration (and by the
+        # fan-out when resolving handles): the driver may produce this
+        # generation's first event the instant the session is visible, and
+        # must not find the handle missing.
+        self._hlock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._unpaused = threading.Event()
+        self._unpaused.set()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+        self._thread = threading.Thread(
+            target=self._drive, name="engine-driver", daemon=True
+        )
+        self._thread.start()
+
+    # Test/drain hook: a paused driver stops ticking the engine (submitted
+    # sessions stay queued), which makes queue-full and deadline scenarios
+    # deterministic.
+    def pause(self) -> None:
+        self._unpaused.clear()
+
+    def resume(self) -> None:
+        self._unpaused.set()
+
+    def _drive(self) -> None:
+        while not self._stop_evt.is_set():
+            if not self._unpaused.is_set() or not self.engine.has_work():
+                time.sleep(self._idle_sleep_s)
+                continue
+            events = self.engine.step()
+            if events:
+                self._fanout(events)
+            self.engine.collect_finished()
+
+    def _fanout(self, events: List) -> None:
+        with self._hlock:
+            for gid, token, finished in events:
+                if finished:
+                    h = self._handles.pop(gid, None)
+                else:
+                    h = self._handles.get(gid)
+                if h is None:
+                    continue  # caller already gone (disconnect races a tick)
+                reason = None
+                if finished:
+                    s = self.engine.sessions.get(gid)
+                    reason = s.finish_reason if s is not None else "cancelled"
+                ev = TokenEvent(token, finished, reason)
+                try:
+                    self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)
+                except RuntimeError:
+                    pass  # loop already closed (server exited mid-tick)
+
+    def submit(self, prompt, options, deadline) -> Handle:
+        with self._hlock:
+            gid = self.engine.submit(prompt, options, deadline=deadline)
+            h = Handle(gen_id=gid, queue=asyncio.Queue())
+            self._handles[gid] = h
+        return h
+
+    def cancel(self, handle: Handle) -> None:
+        # The scheduler reaps at the next tick and emits the terminal
+        # event; _fanout pops the handle then.
+        self.engine.cancel(handle.gen_id)
+
+    def active_sessions(self) -> int:
+        return self.engine.active_sessions()
+
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_evt.set()
+        self._unpaused.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+
+class ClientBackend(Backend):
+    """Relay-tier backend: one worker thread per in-flight generation
+    (the relay hop IS the batching point — workers co-batch sessions on
+    their task pools, so per-request client threads don't serialize)."""
+
+    def __init__(self, client, request_timeout_s: float = 60.0):
+        self.client = client
+        self.metrics = Metrics()
+        self._request_timeout_s = request_timeout_s
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._threads: Dict[str, threading.Thread] = {}
+        self._tlock = threading.Lock()
+        self._ids = 0
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+
+    def submit(self, prompt, options, deadline) -> Handle:
+        with self._tlock:
+            self._ids += 1
+            gid = f"req-{self._ids}"
+        h = Handle(gen_id=gid, queue=asyncio.Queue(), stop=threading.Event())
+        t = threading.Thread(
+            target=self._run, args=(h, list(prompt), options, deadline),
+            name=f"client-{gid}", daemon=True,
+        )
+        with self._tlock:
+            self._threads[gid] = t
+        t.start()
+        return h
+
+    def _run(self, h: Handle, prompt, options, deadline) -> None:
+        def emit(ev: TokenEvent) -> None:
+            try:
+                self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)
+            except RuntimeError:
+                pass  # loop already closed (server exited mid-generation)
+
+        expired = [False]
+
+        def stop_check() -> bool:
+            if h.stop.is_set():
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                expired[0] = True
+                return True
+            return False
+
+        eos = options.eos_token_id if options.eos_token_id >= 0 else None
+        out: List[int] = []
+        reason = "length"
+        try:
+            out = self.client.generate(
+                prompt,
+                max_new_tokens=options.max_new_tokens,
+                eos_token_id=eos,
+                timeout=self._request_timeout_s,
+                options=options,
+                on_token=lambda t: emit(TokenEvent(t, False)),
+                stop_check=stop_check,
+            )
+            if expired[0]:
+                reason = "deadline"
+                self.metrics.counter("sessions_deadline_expired")
+            elif h.stop.is_set():
+                reason = "cancelled"
+            elif eos is not None and out and out[-1] == eos:
+                reason = "eos"
+        except Exception as e:  # noqa: BLE001 - the stream must terminate
+            self.metrics.counter("client_generate_errors")
+            reason = f"error: {type(e).__name__}"
+        finally:
+            self.metrics.counter("sessions_finished")
+            emit(TokenEvent(-1, True, reason))
+            with self._tlock:
+                self._threads.pop(h.gen_id, None)
+
+    def cancel(self, handle: Handle) -> None:
+        if handle.stop is not None:
+            handle.stop.set()
+
+    def active_sessions(self) -> int:
+        with self._tlock:
+            return len(self._threads)
+
+    def queue_depth(self) -> int:
+        return 0  # admission happens downstream, on the workers
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._tlock:
+            threads = list(self._threads.values())
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))

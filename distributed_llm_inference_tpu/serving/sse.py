@@ -1,0 +1,30 @@
+"""Server-Sent Events framing for the streaming completions path.
+
+The OpenAI streaming wire format: each chunk is one ``data: <json>``
+event, the stream ends with the literal ``data: [DONE]`` sentinel. SSE
+needs no Content-Length — the gateway closes the connection to delimit
+the body (HTTP/1.1 ``Connection: close``), so chunked encoding stays out
+of the stdlib-only server.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_event(data: Any) -> bytes:
+    """One SSE frame: ``data: <compact json>\\n\\n``."""
+    return b"data: " + json.dumps(data, separators=(",", ":")).encode() + b"\n\n"
+
+
+def sse_headers(status: str = "200 OK") -> bytes:
+    return (
+        f"HTTP/1.1 {status}\r\n"
+        "Content-Type: text/event-stream\r\n"
+        "Cache-Control: no-cache\r\n"
+        "Connection: close\r\n"
+        "\r\n"
+    ).encode()

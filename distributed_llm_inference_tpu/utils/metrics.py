@@ -13,10 +13,13 @@ import collections
 import contextlib
 import json
 import logging
+import re
 import statistics
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
 
 logger = logging.getLogger("distributed_llm_inference_tpu")
 
@@ -76,3 +79,44 @@ class Metrics:
 
     def log_snapshot(self) -> None:
         logger.info("metrics %s", json.dumps(self.snapshot(), sort_keys=True))
+
+    def prometheus(
+        self,
+        prefix: str = "dli",
+        extra_gauges: Optional[Dict[str, float]] = None,
+    ) -> str:
+        """Prometheus text exposition (the ``/metrics`` endpoint body).
+
+        Counters become ``<prefix>_<name>_total`` counters; timings become
+        ``<prefix>_<name>_seconds`` summaries (p50/p99 quantiles + _sum +
+        _count); ``extra_gauges`` are point-in-time gauges (queue depth,
+        active sessions) sampled by the caller."""
+
+        def clean(name: str) -> str:
+            return _PROM_NAME.sub("_", f"{prefix}_{name}")
+
+        with self._lock:
+            counters = dict(self._counters)
+            timings = {k: list(v) for k, v in self._timings.items()}
+        lines: List[str] = []
+        for name in sorted(counters):
+            metric = clean(name) + "_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {counters[name]:.10g}")
+        for name in sorted(timings):
+            vals = sorted(timings[name])
+            if not vals:
+                continue
+            metric = clean(name) + "_seconds"
+            lines.append(f"# TYPE {metric} summary")
+            p50 = vals[len(vals) // 2]
+            p99 = vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+            lines.append(f'{metric}{{quantile="0.5"}} {p50:.10g}')
+            lines.append(f'{metric}{{quantile="0.99"}} {p99:.10g}')
+            lines.append(f"{metric}_sum {sum(vals):.10g}")
+            lines.append(f"{metric}_count {len(vals)}")
+        for name in sorted(extra_gauges or {}):
+            metric = clean(name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {extra_gauges[name]:.10g}")
+        return "\n".join(lines) + "\n"

@@ -108,6 +108,36 @@ def test_allocator_invariants():
         a.free([0])  # null page
 
 
+def test_ingest_masks_unowned_table_slots():
+    """Ring ingest must scatter only the first ceil(n_valid/page_size)
+    table slots: pages mapped in later slots (e.g. shared prefix pages a
+    future caller leaves installed) must come through byte-identical, not
+    overwritten with ring padding."""
+    paged, _ = _paged(1, [(0, 4)])  # pages 1..4 mapped, page_size=4
+    marker = jnp.full(paged.k_pages.shape[2:], 7.25, jnp.float32)
+    victim = int(paged.page_table[0, 3])
+    paged = paged.replace(
+        k_pages=paged.k_pages.at[:, victim].set(marker),
+        v_pages=paged.v_pages.at[:, victim].set(marker),
+    )
+
+    # 5 valid tokens own ceil(5/4) = 2 slots; slots 2-3 are unowned.
+    n_valid = 5
+    ks = jnp.arange(
+        CFG.num_layers * 8 * CFG.num_kv_heads * CFG.head_dim, dtype=jnp.float32
+    ).reshape(CFG.num_layers, 1, 8, CFG.num_kv_heads, CFG.head_dim)
+    out = paged.ingest_row(ks, ks * 2.0, n_valid)
+
+    assert out.lengths.tolist() == [n_valid]
+    assert (np.asarray(out.k_pages[:, victim]) == 7.25).all()
+    assert (np.asarray(out.v_pages[:, victim]) == 7.25).all()
+    # The owned run did land: first page holds the first page_size tokens.
+    first_page = int(out.page_table[0, 0])
+    got = np.swapaxes(np.asarray(out.k_pages[:, first_page]), 1, 2)
+    want = np.asarray(ks[:, 0, :4])
+    np.testing.assert_array_equal(got, want)
+
+
 def test_quantized_paged_engine_matches_exact():
     """int8 page pool (kernel, fused-tail, and XLA-gather paths) agrees with
     the exact bf16 paged engine."""
