@@ -12,6 +12,8 @@ Subcommands map onto the deployment roles:
 * ``api``       HTTP gateway: OpenAI-compatible ``/v1/completions`` (JSON +
                 SSE streaming) over the local engine, or over the relay
                 chain with ``--relay``; ``/metrics`` + ``/healthz`` included
+* ``chaos``     fault-injecting TCP proxy in front of a relay hub: point
+                endpoints at its port and replay a seeded failure schedule
 * ``info``      inspect a checkpoint (config, layer count, shard files)
 
 Examples::
@@ -23,6 +25,8 @@ Examples::
     distribute local --model /ckpt/llama --prompt-ids 1,2,3 --max-new 32
     distribute api --model /ckpt/llama --port 8000
     distribute api --model /ckpt/llama --port 8000 --relay :18900
+    distribute chaos --upstream :18900 --port 18901 --seed 7 \\
+        --fault 'drop:block.*:put:after=5,count=2' --fault 'sever:*:any'
 """
 
 from __future__ import annotations
@@ -316,6 +320,9 @@ def cmd_api(args) -> int:
         default_timeout_s=args.timeout,
         drain_timeout_s=args.drain_timeout,
         model_name=args.model,
+        breaker_failure_threshold=args.breaker_failures,
+        breaker_recovery_s=args.breaker_recovery,
+        breaker_probe_interval_s=args.breaker_probe_interval,
     )
     if args.relay:
         from .distributed.client import DistributedClient
@@ -349,6 +356,44 @@ def cmd_api(args) -> int:
     server.serve_forever(ready_cb=lambda port: print(
         json.dumps({"event": "api_up", "port": port}), flush=True
     ))
+    return 0
+
+
+def cmd_chaos(args) -> int:
+    """Stand a fault-injecting proxy in front of a relay hub. Point the
+    endpoints under test (``serve``/``generate``/``api --relay``) at the
+    proxy's port; the seeded plan makes the failure sequence replayable —
+    same seed + same faults + same traffic = same injections (reported as
+    JSON events and in a final summary on shutdown)."""
+    from .distributed.chaos import ChaosProxy, FaultPlan
+
+    host, port = _parse_relay(args.upstream)
+    plan = FaultPlan.from_specs(args.fault or [], seed=args.seed)
+    proxy = ChaosProxy(host, port, port=args.port, plan=plan)
+    print(json.dumps({
+        "event": "chaos_up", "port": proxy.port,
+        "upstream": f"{host}:{port}", "seed": args.seed,
+        "faults": args.fault or [],
+    }), flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    seen = 0
+    try:
+        while not stop:
+            time.sleep(0.2)
+            injected = plan.injected[seen:]
+            seen += len(injected)
+            for kind, queue, op in injected:
+                print(json.dumps({
+                    "event": "fault_injected", "kind": kind,
+                    "queue": queue, "op": op,
+                }), flush=True)
+    finally:
+        proxy.stop()
+        print(json.dumps({
+            "event": "chaos_down", "injected": len(plan.injected),
+        }), flush=True)
     return 0
 
 
@@ -501,6 +546,14 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--drain-timeout", type=float, default=30.0,
                    help="SIGTERM drain budget before in-flight requests "
                         "are cancelled")
+    a.add_argument("--breaker-failures", type=int, default=5,
+                   help="consecutive backend failures that open the "
+                        "circuit breaker (503 + Retry-After while open)")
+    a.add_argument("--breaker-recovery", type=float, default=5.0,
+                   help="seconds the breaker stays open before admitting "
+                        "half-open trial traffic")
+    a.add_argument("--breaker-probe-interval", type=float, default=1.0,
+                   help="backend health-probe period seconds (0 disables)")
     a.add_argument("--max-sessions", type=int, default=8)
     a.add_argument("--max-seq-len", type=int, default=2048)
     a.add_argument("--dtype", default="bfloat16")
@@ -512,6 +565,27 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--weights-cache", default=None,
                    help="directory for pre-converted weight caching")
     a.set_defaults(fn=cmd_api)
+
+    c = sub.add_parser(
+        "chaos",
+        help="fault-injecting TCP proxy in front of a relay hub "
+             "(replayable seeded failure schedules)",
+    )
+    c.add_argument("--upstream", required=True,
+                   help="host:port of the real relay hub")
+    c.add_argument("--port", type=int, default=0,
+                   help="port to listen on (0 = ephemeral, printed in "
+                        "chaos_up)")
+    c.add_argument("--seed", type=int, default=0,
+                   help="seeds probabilistic rules and corrupt-byte choice")
+    c.add_argument("--fault", action="append", default=None,
+                   metavar="KIND:QUEUE:OP[:K=V,...]",
+                   help="repeatable fault spec, e.g. "
+                        "'drop:block.*:put:after=5,count=2', "
+                        "'corrupt:client.*:reply', 'delay:*:any:"
+                        "delay_s=0.2,prob=0.3,count=none'; kinds: drop, "
+                        "delay, duplicate, truncate, corrupt, sever")
+    c.set_defaults(fn=cmd_chaos)
 
     i = sub.add_parser("info", help="inspect a checkpoint")
     i.add_argument("--model", required=True)

@@ -290,3 +290,14 @@ class ServingConfig:
     idle_sleep_s: float = 0.002
     # Reported as the OpenAI "model" field in responses.
     model_name: str = "distributed-llm-inference-tpu"
+    # Circuit breaker (serving/breaker.py): after this many consecutive
+    # backend failures the gateway fails fast (503 + Retry-After) instead
+    # of burning a full timeout per doomed request ...
+    breaker_failure_threshold: int = 5
+    # ... for this long, then admits trial traffic again (half-open) ...
+    breaker_recovery_s: float = 5.0
+    # ... and closes after this many consecutive trial successes.
+    breaker_success_threshold: int = 1
+    # Background backend health-probe period (seconds; 0 disables). Probes
+    # can open the breaker with zero traffic and drive recovery.
+    breaker_probe_interval_s: float = 1.0

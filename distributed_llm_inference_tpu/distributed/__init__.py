@@ -3,6 +3,7 @@ nodes, and the client/orchestrator — the layer hivemind provided (or the
 reference left as stubs). Intra-slice parallelism lives in ``parallel/``."""
 
 from .backend import BlockBackend, SchemaError
+from .chaos import ChaosProxy, ChaosRelayClient, FaultPlan, FaultRule
 from .client import DistributedClient
 from .directory import BlockDirectory, DirectoryClient, DirectoryService
 from .relay import RelayClient, RelayServer, native_available
@@ -12,6 +13,10 @@ from .worker import ServingNode
 __all__ = [
     "BlockBackend",
     "SchemaError",
+    "ChaosProxy",
+    "ChaosRelayClient",
+    "FaultPlan",
+    "FaultRule",
     "DistributedClient",
     "BlockDirectory",
     "DirectoryClient",

@@ -1,0 +1,404 @@
+"""Deterministic fault injection for the relay transport.
+
+The paper's fabric is volunteer hardware over WAN links — frames get
+dropped, delayed, duplicated, truncated, and (rarely, below TCP's own
+16-bit checksum) corrupted, and whole connections sever mid-operation.
+The failover machinery in ``client.py``/``worker.py`` only earns trust if
+those faults can be produced ON DEMAND and REPLAYED exactly, so this
+module provides:
+
+* :class:`FaultRule` / :class:`FaultPlan` — a seeded schedule of faults,
+  matchable by queue glob and operation. Same rules + same seed + same
+  traffic ⇒ same injected sequence (the plan keeps an ``injected`` log so
+  tests can assert the faults actually fired).
+* :class:`ChaosProxy` — an in-process TCP proxy that sits between relay
+  endpoints and the native hub, parses the real wire protocol in both
+  directions, and applies the plan to individual frames. Because it
+  mangles wire bytes AFTER the sender computed the frame CRC, a
+  ``corrupt`` fault is a true in-flight corruption, not a re-signed one.
+* :class:`ChaosRelayClient` — a :class:`RelayClient` whose connection
+  transparently runs through its own :class:`ChaosProxy`.
+
+Fault classes (``FaultRule.kind``):
+
+================  ============================================================
+``drop``          frame is swallowed; receiver sees a lost frame
+``delay``         frame is forwarded after ``delay_s`` (reordering pressure)
+``duplicate``     frame is forwarded twice (at-least-once delivery)
+``truncate``      first half of the frame is sent, then the connection severs
+``corrupt``       one payload byte is flipped (seeded choice); CRC catches it
+``sever``         connection is closed mid-operation; frame is not forwarded
+================  ============================================================
+
+CANCEL frames and the 8-byte cancel-ack sentinel are control traffic and
+always pass untouched — chaosing the timeout handshake itself would test
+the injector, not the transport.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import random
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from .relay import CANCEL_ACK, OP_CANCEL, OP_GET, OP_PING, OP_PUT, RelayClient
+
+__all__ = ["FaultRule", "FaultPlan", "ChaosProxy", "ChaosRelayClient"]
+
+KINDS = ("drop", "delay", "duplicate", "truncate", "corrupt", "sever")
+
+# Wire-direction op names a rule can match. ``put``/``get``/``ping`` are
+# client→hub requests; ``reply`` is any hub→client payload frame.
+OPS = ("put", "get", "ping", "reply", "any")
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One line of a fault schedule.
+
+    ``queue`` is a glob matched against the frame's queue name (requests
+    carry it; replies are attributed to the queue of the GET/PING they
+    answer). ``after`` skips the first N matching frames, ``count`` caps
+    how many times the rule fires (None = unlimited), ``prob`` draws from
+    the plan's seeded RNG.
+    """
+
+    kind: str
+    queue: str = "*"
+    op: str = "any"
+    after: int = 0
+    count: Optional[int] = 1
+    prob: float = 1.0
+    delay_s: float = 0.05
+    # mutable match state (owned by the plan's lock)
+    seen: int = 0
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (not in {KINDS})")
+        if self.op not in OPS:
+            raise ValueError(f"unknown op {self.op!r} (not in {OPS})")
+
+    def matches(self, queue: str, op: str) -> bool:
+        if self.op != "any" and self.op != op:
+            return False
+        return fnmatch.fnmatchcase(queue, self.queue)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultRule":
+        """Parse a CLI spec ``kind:queue:op[:k=v,...]``, e.g.
+        ``drop:block.*:put:after=3,count=2`` or
+        ``delay:client.*:reply:delay_s=0.2,prob=0.5``."""
+        parts = spec.split(":")
+        if len(parts) < 3:
+            raise ValueError(
+                f"fault spec {spec!r} needs at least kind:queue:op"
+            )
+        kind, queue, op = parts[0], parts[1], parts[2]
+        kwargs = {}
+        if len(parts) > 3 and parts[3]:
+            for item in parts[3].split(","):
+                k, _, v = item.partition("=")
+                k = k.strip()
+                if k == "count":
+                    kwargs[k] = None if v in ("none", "inf") else int(v)
+                elif k == "after":
+                    kwargs[k] = int(v)
+                elif k in ("prob", "delay_s"):
+                    kwargs[k] = float(v)
+                else:
+                    raise ValueError(f"unknown fault option {k!r} in {spec!r}")
+        return cls(kind=kind, queue=queue, op=op, **kwargs)
+
+
+class FaultPlan:
+    """A seeded, thread-safe schedule of :class:`FaultRule`.
+
+    ``decide(queue, op)`` returns the first rule that fires for a frame
+    (or None). All randomness — probabilistic firing and the corrupt-byte
+    choice — comes from one seeded RNG under one lock, so a plan replays
+    identically for identical traffic.
+    """
+
+    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.injected: List[Tuple[str, str, str]] = []  # (kind, queue, op)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_specs(cls, specs: Sequence[str], seed: int = 0) -> "FaultPlan":
+        return cls([FaultRule.parse(s) for s in specs], seed=seed)
+
+    def decide(self, queue: str, op: str) -> Optional[FaultRule]:
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(queue, op):
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.count is not None and rule.fired >= rule.count:
+                    continue
+                if rule.prob < 1.0 and self.rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                self.injected.append((rule.kind, queue, op))
+                return rule
+        return None
+
+    def corrupt(self, payload: bytes) -> bytes:
+        """Flip one bit of one seeded-chosen byte (never a no-op)."""
+        with self._lock:
+            i = self.rng.randrange(len(payload))
+        b = bytearray(payload)
+        b[i] ^= 0x01
+        return bytes(b)
+
+
+class _Pipe:
+    """One proxied connection: client socket ↔ upstream hub socket, a
+    parsing forwarder thread per direction."""
+
+    def __init__(self, proxy: "ChaosProxy", client: socket.socket):
+        self.proxy = proxy
+        self.client = client
+        self.upstream = socket.create_connection(
+            (proxy.upstream_host, proxy.upstream_port)
+        )
+        self.upstream.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._severed = False
+        # RelayClient is strictly serial (one outstanding GET/PING per
+        # connection), so the queue of the last request is enough to
+        # attribute the next reply frame.
+        self.last_tag = "*"
+        for name, fn in (("c2s", self._c2s), ("s2c", self._s2c)):
+            t = threading.Thread(
+                target=self._guard, args=(fn,),
+                name=f"chaos-{name}-{id(self) & 0xffff:x}", daemon=True,
+            )
+            t.start()
+
+    def sever(self) -> None:
+        with self._lock:
+            if self._severed:
+                return
+            self._severed = True
+        for s in (self.client, self.upstream):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.proxy._forget(self)
+
+    def _guard(self, fn) -> None:
+        try:
+            fn()
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            self.sever()
+
+    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("chaos pipe closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _apply(
+        self,
+        dst: socket.socket,
+        frame: bytes,
+        queue: str,
+        op: str,
+        payload_off: int,
+    ) -> None:
+        """Run the plan on one complete frame and forward accordingly.
+        ``payload_off`` is where the CRC-covered payload starts inside
+        ``frame`` (len(frame) for payload-less frames)."""
+        rule = None if self.proxy.plan is None else self.proxy.plan.decide(
+            queue, op
+        )
+        if rule is None:
+            dst.sendall(frame)
+            return
+        kind = rule.kind
+        if kind == "drop":
+            return
+        if kind == "delay":
+            time.sleep(rule.delay_s)
+            dst.sendall(frame)
+            return
+        if kind == "duplicate":
+            dst.sendall(frame + frame)
+            return
+        if kind == "corrupt" and payload_off < len(frame):
+            payload = self.proxy.plan.corrupt(frame[payload_off:])
+            dst.sendall(frame[:payload_off] + payload)
+            return
+        if kind == "truncate":
+            dst.sendall(frame[: max(1, len(frame) // 2)])
+            self.sever()
+            raise ConnectionError("chaos: truncated frame")
+        # sever (and corrupt on a payload-less frame, where there is
+        # nothing under the CRC to flip): kill the connection.
+        self.sever()
+        raise ConnectionError("chaos: severed connection")
+
+    def _c2s(self) -> None:
+        """Parse client→hub requests: [op:1][qlen:2][queue] plus, for PUT,
+        [len:8][crc:4][payload]."""
+        while True:
+            head = self._read_exact(self.client, 3)
+            op, qlen = struct.unpack(">BH", head)
+            qbytes = self._read_exact(self.client, qlen)
+            queue = qbytes.decode("utf-8", "replace")
+            if op == OP_PUT:
+                meta = self._read_exact(self.client, 12)
+                (plen,) = struct.unpack(">Q", meta[:8])
+                payload = self._read_exact(self.client, plen)
+                frame = head + qbytes + meta + payload
+                self._apply(
+                    self.upstream, frame, queue, "put", 3 + qlen + 12
+                )
+                continue
+            frame = head + qbytes
+            if op == OP_GET:
+                self.last_tag = queue
+                self._apply(self.upstream, frame, queue, "get", len(frame))
+            elif op == OP_PING:
+                self.last_tag = "<ping>"
+                self._apply(
+                    self.upstream, frame, "<ping>", "ping", len(frame)
+                )
+            else:  # CANCEL (or unknown): control traffic, never chaosed
+                self.upstream.sendall(frame)
+
+    def _s2c(self) -> None:
+        """Parse hub→client replies: [len:8][crc:4][payload], or the bare
+        8-byte CANCEL_ACK sentinel (forwarded untouched)."""
+        while True:
+            len8 = self._read_exact(self.upstream, 8)
+            (length,) = struct.unpack(">Q", len8)
+            if length == CANCEL_ACK:
+                self.client.sendall(len8)
+                continue
+            rest = self._read_exact(self.upstream, 4 + length)
+            frame = len8 + rest
+            self._apply(self.client, frame, self.last_tag, "reply", 12)
+
+
+class ChaosProxy:
+    """TCP chaos proxy in front of a relay hub.
+
+    Endpoints connect to ``proxy.port`` instead of the hub; every frame in
+    either direction is parsed and run through the :class:`FaultPlan`.
+    Reconnects (e.g. after a ``sever`` fault) land on a fresh upstream
+    connection, so backoff/retry paths are exercised end to end.
+    """
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        port: int = 0,
+        plan: Optional[FaultPlan] = None,
+    ):
+        self.upstream_host = upstream_host
+        self.upstream_port = upstream_port
+        self.plan = plan
+        self._pipes: List[_Pipe] = []
+        self._plock = threading.Lock()
+        self._closed = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="chaos-accept", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            try:
+                pipe = _Pipe(self, client)
+            except OSError:
+                client.close()  # upstream hub is down right now
+                continue
+            with self._plock:
+                self._pipes.append(pipe)
+
+    def _forget(self, pipe: _Pipe) -> None:
+        with self._plock:
+            try:
+                self._pipes.remove(pipe)
+            except ValueError:
+                pass
+
+    def sever_all(self) -> None:
+        """Kill every live proxied connection (a hub 'blip' on demand)."""
+        with self._plock:
+            pipes = list(self._pipes)
+        for p in pipes:
+            p.sever()
+
+    def stop(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.sever_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ChaosRelayClient(RelayClient):
+    """A :class:`RelayClient` that dials the hub through its own private
+    :class:`ChaosProxy`, so one endpoint can be subjected to a fault plan
+    while the rest of the cluster stays on the clean path."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        plan: Optional[FaultPlan] = None,
+        **kwargs,
+    ):
+        self.proxy = ChaosProxy(host, port, plan=plan)
+        super().__init__("127.0.0.1", self.proxy.port, **kwargs)
+
+    @property
+    def plan(self) -> Optional[FaultPlan]:
+        return self.proxy.plan
+
+    def close(self) -> None:
+        super().close()
+        self.proxy.stop()

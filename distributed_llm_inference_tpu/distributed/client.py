@@ -26,6 +26,7 @@ import numpy as np
 from ..config import ModelConfig
 from ..engine.sampling import SamplingOptions, SamplingParams, sample
 from ..models import llama
+from ..utils.metrics import Metrics
 from .directory import DirectoryClient
 from .messages import pack_frame, unpack_frame
 from .relay import RelayClient
@@ -76,6 +77,7 @@ class DistributedClient:
         self._directory = DirectoryClient(relay_port, host)
         self._dir_lock = threading.Lock()
         self.failovers = 0  # mid-generation re-route count (observability)
+        self.metrics = Metrics()  # /metrics surface for chaos observability
 
         self._embed = jax.jit(
             lambda emb, t: jnp.take(emb, t, axis=0).astype(self.dtype)
@@ -111,28 +113,51 @@ class DistributedClient:
 
     def _send_through(self, relay, route, gen_id: str, x: np.ndarray,
                       num_new: int, timeout: float, reply_queue: str,
-                      new: bool = False) -> np.ndarray:
+                      new: bool = False, seq: int = 0) -> np.ndarray:
         hops = [n["queue"] for n in route[1:]] + [reply_queue]
+        # ``seq`` numbers every hop of a generation: workers skip a frame
+        # whose seq they already applied (an at-least-once transport must
+        # not advance the KV cache twice), and the reply loop below skips
+        # duplicated replies instead of mistaking them for the next hop's.
         header = {"op": "forward", "gen_id": gen_id, "num_new": num_new,
-                  "hops": hops, "new": new}
+                  "hops": hops, "new": new, "seq": seq}
         relay.put(route[0]["queue"], pack_frame(header, np.asarray(x)))
-        reply_header, y = unpack_frame(relay.get(reply_queue, timeout=timeout))
-        if reply_header.get("op") == "error":
-            msg = f"worker {reply_header.get('from')}: {reply_header['error']}"
-            # Retryability keys on the machine-readable code (worker.py:
-            # error_code); the message-text fallback covers frames from
-            # older workers that predate the code field.
-            code = reply_header.get("code")
-            retryable = (
-                code == "unknown_generation" if code is not None
-                else "unknown generation" in reply_header["error"]
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no reply for {gen_id} hop seq={seq} within {timeout}s"
+                )
+            reply_header, y = unpack_frame(
+                relay.get(reply_queue, timeout=remaining)
             )
-            raise WorkerError(msg, retryable=retryable)
-        if reply_header.get("gen_id") != gen_id:
-            raise RuntimeError(
-                "out-of-order reply on a per-generation queue (protocol bug)"
-            )
-        return y
+            if reply_header.get("op") == "error":
+                msg = (
+                    f"worker {reply_header.get('from')}: "
+                    f"{reply_header['error']}"
+                )
+                # Retryability keys on the machine-readable code (worker.py:
+                # error_code); the message-text fallback covers frames from
+                # older workers that predate the code field.
+                code = reply_header.get("code")
+                retryable = (
+                    code == "unknown_generation" if code is not None
+                    else "unknown generation" in reply_header["error"]
+                )
+                raise WorkerError(msg, retryable=retryable)
+            if reply_header.get("gen_id") != gen_id:
+                raise RuntimeError(
+                    "out-of-order reply on a per-generation queue "
+                    "(protocol bug)"
+                )
+            rseq = reply_header.get("seq")
+            if rseq is not None and rseq != seq:
+                # A duplicated delivery of an earlier hop's reply: discard
+                # and keep waiting for the real one.
+                self.metrics.counter("stale_replies_discarded")
+                continue
+            return y
 
     def _end_session(self, relay, route, gen_id: str) -> None:
         """Best-effort: surviving nodes free the session's cache row; dead
@@ -225,6 +250,7 @@ class DistributedClient:
                     raise  # deterministic worker error: replay cannot help
                 failures += 1
                 self.failovers += 1
+                self.metrics.counter("failovers")
                 if failures > max_retries:
                     raise
                 if stop_check is not None and stop_check():
@@ -238,7 +264,7 @@ class DistributedClient:
                         reply_queue):
         """Push ``tokens`` through the chain in bucket-sized chunks (the
         first with ``new=True``); returns ``(last chunk's hidden states,
-        index of the last valid position in that chunk)``."""
+        index of the last valid position in that chunk, next hop seq)``."""
         cap = self.prefill_buckets[-1]
         chunks = [tokens[i : i + cap] for i in range(0, len(tokens), cap)]
         y, last_n = None, 0
@@ -249,9 +275,10 @@ class DistributedClient:
             padded[0, :n] = np.asarray(chunk, np.int32)
             x = self._embed(self.params["embed"], jnp.asarray(padded))
             y = self._send_through(relay, route, gen_id, np.asarray(x), n,
-                                   timeout, reply_queue, new=(ci == 0))
+                                   timeout, reply_queue, new=(ci == 0),
+                                   seq=ci)
             last_n = n
-        return y, last_n
+        return y, last_n, len(chunks)
 
     def _next_token(self, y, idx, opts, key, step):
         """Sample the next token from hidden states ``y`` at position
@@ -286,7 +313,7 @@ class DistributedClient:
             # first decode step below). Chunked, so a replay longer than one
             # bucket (long generation before the failure) still fits.
             replay = prompt + out[:-1]
-            y, last_n = self._prefill_chunks(
+            y, last_n, seq = self._prefill_chunks(
                 relay, route, gen_id, replay, timeout, reply_queue
             )
             if out:
@@ -306,7 +333,8 @@ class DistributedClient:
                     self.params["embed"], jnp.asarray([[token]], jnp.int32)
                 )
                 y = self._send_through(relay, route, gen_id, np.asarray(x),
-                                       1, timeout, reply_queue)
+                                       1, timeout, reply_queue, seq=seq)
+                seq += 1
                 token = self._next_token(y, 0, opts, key, len(out))
                 out.append(token)
                 if on_token is not None:

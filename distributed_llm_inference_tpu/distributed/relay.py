@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import socket
 import struct
 import subprocess
 import threading
+import time
+import zlib
 from typing import Optional, Tuple
 
 import numpy as np
@@ -33,6 +36,11 @@ _build_lock = threading.Lock()
 
 OP_PUT, OP_GET, OP_PING, OP_CANCEL = 1, 2, 3, 4
 CANCEL_ACK = (1 << 64) - 1
+
+
+def frame_crc(payload: bytes) -> int:
+    """CRC-32 of a frame payload (zlib/IEEE — matches the hub's table)."""
+    return zlib.crc32(payload) & 0xFFFFFFFF
 
 
 def build_native(force: bool = False) -> str:
@@ -103,8 +111,20 @@ class RelayClient:
     recycled (the server drops dead waiters), keeping FIFO semantics clean.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        reconnect_timeout_s: float = 10.0,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 1.0,
+    ):
         self.host, self.port = host, port
+        self.reconnect_timeout_s = reconnect_timeout_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.reconnects = 0  # successful re-dials (observability)
+        self._closed = False
         self._sock: Optional[socket.socket] = None
         self._connect()
 
@@ -113,18 +133,41 @@ class RelayClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _reconnect(self) -> None:
-        """Drop the (dead) connection and dial again — the transparent
-        retry-once path for control-plane restarts (SURVEY §5.3: a relay
-        restart must not permanently wedge long-lived clients like the
-        worker's reply connection or the directory handle)."""
+        """Drop the (dead) connection and dial again with bounded
+        exponential backoff + jitter — the transparent retry path for
+        control-plane restarts (SURVEY §5.3: a hub restart of a few seconds
+        must not permanently wedge long-lived clients like the worker's
+        reply connection or the directory handle, so one failed dial is not
+        the end: keep trying inside ``reconnect_timeout_s``)."""
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
-        self._connect()
+            self._sock = None
+        deadline = time.monotonic() + self.reconnect_timeout_s
+        attempt = 0
+        while True:
+            if self._closed:
+                raise ConnectionError("relay client is closed")
+            try:
+                self._connect()
+                self.reconnects += 1
+                return
+            except OSError as e:
+                attempt += 1
+                delay = min(
+                    self.backoff_max_s, self.backoff_base_s * (2 ** (attempt - 1))
+                ) * (0.5 + 0.5 * random.random())  # jitter: desync herds
+                if time.monotonic() + delay >= deadline:
+                    raise ConnectionError(
+                        f"relay {self.host}:{self.port} unreachable after "
+                        f"{attempt} attempts: {e}"
+                    ) from e
+                time.sleep(delay)
 
     def close(self) -> None:
+        self._closed = True  # a concurrent _reconnect must stop dialing
         if self._sock is not None:
             self._sock.close()
             self._sock = None
@@ -141,14 +184,25 @@ class RelayClient:
         if self._sock is None:
             raise ConnectionError("relay client is closed")
 
+    @staticmethod
+    def _encode_put(queue: str, payload: bytes) -> bytes:
+        """One PUT frame: ``[op][qlen][queue][len:8][crc:4][payload]``. The
+        CRC travels in the header so the hub can reject a payload damaged
+        in flight at ingress (and the chaos layer can damage the wire bytes
+        AFTER the crc is computed — a true corruption, not a re-signed one).
+        """
+        q = queue.encode()
+        return (
+            struct.pack(">BH", OP_PUT, len(q)) + q
+            + struct.pack(">QI", len(payload), frame_crc(payload))
+            + payload
+        )
+
     def put(self, queue: str, payload: bytes) -> None:
         self._require_open()
-        q = queue.encode()
-        header = struct.pack(">BH", OP_PUT, len(q)) + q + struct.pack(
-            ">Q", len(payload)
-        )
+        frame = self._encode_put(queue, payload)
         try:
-            self._sock.sendall(header + payload)
+            self._sock.sendall(frame)
         except (ConnectionError, OSError):
             # Reconnect so the NEXT op runs on a live connection, but do NOT
             # resend: the hub may have fully received the frame before the
@@ -164,12 +218,33 @@ class RelayClient:
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
         while n:
-            chunk = self._sock.recv(min(n, 1 << 20))
+            # Re-read self._sock each round: a concurrent close() nulls it,
+            # and that race must surface as ConnectionError (the condition
+            # callers already handle), never AttributeError.
+            sock = self._sock
+            if sock is None:
+                raise ConnectionError("relay client is closed")
+            chunk = sock.recv(min(n, 1 << 20))
             if not chunk:
                 raise ConnectionError("relay connection closed")
             chunks.append(chunk)
             n -= len(chunk)
         return b"".join(chunks)
+
+    def _recv_payload(self, length: int, queue: str) -> bytes:
+        """Read ``[crc:4][payload:length]`` and verify. A mismatch means the
+        hub→client leg damaged the bytes: recycle the connection (the
+        stream may be desynced if framing itself was hit) and surface a
+        LOST frame — callers time out / fail over and replay; garbage never
+        reaches a model layer."""
+        (crc,) = struct.unpack(">I", self._recv_exact(4))
+        payload = self._recv_exact(length)
+        if frame_crc(payload) != crc:
+            self._reconnect()
+            raise ConnectionError(
+                f"corrupt frame on {queue!r} (crc mismatch): treated as lost"
+            )
+        return payload
 
     def get(self, queue: str, timeout: Optional[float] = None) -> bytes:
         self._require_open()
@@ -182,35 +257,49 @@ class RelayClient:
             return self._get_once(queue, timeout)
 
     def _get_once(self, queue: str, timeout: Optional[float]) -> bytes:
+        sock = self._sock
+        if sock is None:
+            raise ConnectionError("relay client is closed")
         q = queue.encode()
-        self._sock.sendall(struct.pack(">BH", OP_GET, len(q)) + q)
+        sock.sendall(struct.pack(">BH", OP_GET, len(q)) + q)
         # Timeout applies only to the FIRST byte: once the hub has started a
         # reply it will deliver the whole frame, and timing out mid-frame
         # would desync the stream (discarded partial length/payload bytes).
-        self._sock.settimeout(timeout)
+        sock.settimeout(timeout)
         try:
-            first = self._sock.recv(1)
+            first = sock.recv(1)
         except socket.timeout:
-            self._sock.settimeout(None)
+            self._settimeout(None)
             return self._cancel_pending(queue, timeout)
         finally:
-            if self._sock is not None:
-                self._sock.settimeout(None)
+            self._settimeout(None)
         if not first:
             raise ConnectionError("relay connection closed")
         (length,) = struct.unpack(">Q", first + self._recv_exact(7))
-        return self._recv_exact(length)
+        return self._recv_payload(length, queue)
+
+    def _settimeout(self, value) -> None:
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.settimeout(value)
+            except OSError:
+                pass  # closed concurrently; the next recv raises cleanly
 
     def _cancel_pending(self, queue: str, timeout) -> bytes:
         """Race-free GET timeout: CANCEL the parked waiter and read frames
         until the ack sentinel. A real reply that raced ahead of the CANCEL
-        arrives before the ack — return it (arrived late beats lost)."""
-        self._sock.sendall(struct.pack(">BH", OP_CANCEL, 0))
-        self._sock.settimeout(10.0)
+        arrives before the ack — return it (arrived late beats lost). The
+        ack sentinel is the bare 8-byte length ``CANCEL_ACK`` (no crc)."""
+        sock = self._sock
+        if sock is None:
+            raise ConnectionError("relay client is closed")
+        sock.sendall(struct.pack(">BH", OP_CANCEL, 0))
+        self._settimeout(10.0)
         (length,) = struct.unpack(">Q", self._recv_exact(8))
         if length == CANCEL_ACK:
             raise TimeoutError(f"get({queue!r}) timed out after {timeout}s")
-        payload = self._recv_exact(length)
+        payload = self._recv_payload(length, queue)
         (ack,) = struct.unpack(">Q", self._recv_exact(8))
         assert ack == CANCEL_ACK, "protocol desync after GET cancel"
         return payload
@@ -218,12 +307,12 @@ class RelayClient:
     def ping(self, timeout: float = 5.0) -> bool:
         self._require_open()
         self._sock.sendall(struct.pack(">BH", OP_PING, 0))
-        self._sock.settimeout(timeout)
+        self._settimeout(timeout)
         try:
             (length,) = struct.unpack(">Q", self._recv_exact(8))
-            return self._recv_exact(length) == b"PONG"
+            return self._recv_payload(length, "<ping>") == b"PONG"
         finally:
-            self._sock.settimeout(None)
+            self._settimeout(None)
 
     # -- tensor framing -------------------------------------------------------
     # [dtype_len:1][dtype str][ndim:1][dims:8 each][raw bytes]; bfloat16

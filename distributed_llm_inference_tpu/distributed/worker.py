@@ -21,7 +21,6 @@ Frame header ops: ``forward`` (run the block), ``end`` (free the session),
 from __future__ import annotations
 
 import threading
-import time
 import traceback
 import uuid
 from typing import Dict, List, Optional
@@ -29,6 +28,7 @@ from typing import Dict, List, Optional
 from ..config import ModelConfig
 from .backend import BlockBackend, SchemaError
 from .directory import DirectoryClient
+from ..utils.metrics import Metrics
 from .messages import pack_frame, unpack_frame
 from .relay import RelayClient
 from .task_pool import TaskPool
@@ -84,6 +84,11 @@ class ServingNode:
         self._stop = threading.Event()
         self.errors: List[str] = []
         self.restarts = 0
+        self.metrics = Metrics()  # /metrics surface for chaos observability
+        # Highest hop seq applied per generation (pool thread only). An
+        # at-least-once transport (duplicated PUT) must not advance a
+        # session's KV cache twice — the duplicate is skipped, no reply.
+        self._applied_seq: Dict[str, int] = {}
 
         # Register FIRST: a directory/relay failure here must not leak the
         # pool thread or relay sockets (there is no node object to stop()).
@@ -174,15 +179,41 @@ class ServingNode:
         try:
             if items[0][0] == ("end",):
                 for _, header, _ in items:
-                    self.backend.end(header.get("gen_id", ""))
+                    gid = header.get("gen_id", "")
+                    self.backend.end(gid)
+                    self._applied_seq.pop(gid, None)
+                return [None] * len(items)
+            # Hop-seq dedup (pool thread serializes, so no lock): a frame
+            # whose seq this node already applied is a duplicated delivery —
+            # skip it with NO reply (the original's reply already went out;
+            # a second reply would itself be a duplicate downstream).
+            fresh = []
+            for item in items:
+                _, h, _ = item
+                seq, gid = h.get("seq"), h.get("gen_id", "")
+                if seq is not None:
+                    last = self._applied_seq.get(gid)
+                    if last is not None and seq <= last:
+                        self.metrics.counter("duplicate_hops_skipped")
+                        continue
+                    self._applied_seq[gid] = seq
+                fresh.append(item)
+            if len(self._applied_seq) > 4 * self.backend.max_sessions + 16:
+                # "end" frames are best-effort, so entries can leak; prune
+                # against the backend's live session table.
+                live = self.backend.sessions
+                self._applied_seq = {
+                    g: s for g, s in self._applied_seq.items() if g in live
+                }
+            if not fresh:
                 return [None] * len(items)
             reqs = [
                 (h.get("gen_id", ""), arr, h.get("num_new", 0),
                  bool(h.get("new", False)))
-                for _, h, arr in items
+                for _, h, arr in fresh
             ]
             outs = self.backend.forward_many(reqs)
-            for (_, header, _), y in zip(items, outs):
+            for (_, header, _), y in zip(fresh, outs):
                 hops = header.get("hops") or []
                 if isinstance(y, Exception):
                     # Protocol/session errors go back to the client's reply
@@ -206,8 +237,9 @@ class ServingNode:
 
     def _health_loop(self) -> None:
         while not self._stop.is_set():
-            time.sleep(self.heartbeat_s)
-            if self._stop.is_set():
+            # Event.wait, not time.sleep: stop() must return promptly, not
+            # block up to a full heartbeat interval.
+            if self._stop.wait(self.heartbeat_s):
                 return
             try:
                 alive = self._directory.heartbeat(
@@ -226,6 +258,7 @@ class ServingNode:
                 # watchdog just restarts (``module.restart()`` intent,
                 # reference server.py:23).
                 self.restarts += 1
+                self.metrics.counter("worker_restarts")
                 self._consume_thread = self._spawn_consumer()
 
     def is_healthy(self) -> bool:

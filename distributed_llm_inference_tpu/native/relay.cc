@@ -9,12 +9,21 @@
 // length-prefixed binary frames between named FIFO queues.
 //
 // Protocol (all integers big-endian):
-//   request:  [op:1][qlen:2][queue bytes][len:8][payload]
-//     op 1 = PUT     payload appended to `queue` (no ack -- fire and forget)
-//     op 2 = GET     blocks until `queue` has a message; reply [len:8][payload]
-//     op 3 = PING    reply [len:8 = 4]["PONG"]  (health checks / liveness)
+//   request:  [op:1][qlen:2][queue bytes][len:8][crc:4][payload]
+//     op 1 = PUT     payload appended to `queue` (no ack -- fire and forget).
+//                    `crc` is the CRC-32 (IEEE, zlib-compatible) of the
+//                    payload; a mismatch at ingress means the bytes were
+//                    damaged in flight and the frame is DROPPED -- a lost
+//                    frame the endpoints already know how to handle (reply
+//                    timeout -> client replays under a fresh generation_id)
+//                    instead of garbage tokens reaching a model layer.
+//     op 2 = GET     blocks until `queue` has a message; reply
+//                    [len:8][crc:4][payload] (crc recomputed at egress so
+//                    the hub->client leg is covered independently)
+//     op 3 = PING    reply [len:8 = 4][crc:4]["PONG"]  (health / liveness)
 //     op 4 = CANCEL  unpark this connection's pending GET; always acked with
-//                    the sentinel frame [len:8 = ~0]. If a reply raced ahead
+//                    the bare sentinel frame [len:8 = ~0] (no crc). If a
+//                    reply raced ahead
 //                    of the CANCEL it precedes the ack on the wire, so the
 //                    client can distinguish "timed out" from "arrived late"
 //                    without tearing down the connection (a raw close loses
@@ -105,6 +114,38 @@ uint64_t rd64(const uint8_t* src) {
   return v;
 }
 
+void be32(uint8_t* dst, uint32_t v) {
+  for (int i = 3; i >= 0; --i) {
+    dst[i] = v & 0xff;
+    v >>= 8;
+  }
+}
+
+uint32_t rd32(const uint8_t* src) {
+  uint32_t v = 0;
+  for (int i = 0; i < 4; ++i) v = (v << 8) | src[i];
+  return v;
+}
+
+// CRC-32 (IEEE 802.3, reflected poly 0xEDB88320) -- bit-identical to
+// Python's zlib.crc32, so both ends of a frame agree without linking zlib.
+// Only the epoll-loop thread calls this, so the lazy table init is safe.
+uint32_t crc32_ieee(const uint8_t* p, uint64_t n) {
+  static uint32_t table[256];
+  static bool ready = false;
+  if (!ready) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    ready = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < n; ++i) c = table[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 void arm_write(Server* s, Conn* c) {
   epoll_event ev{};
   ev.events = EPOLLIN | (c->wbuf.size() > c->woff ? EPOLLOUT : 0u);
@@ -115,11 +156,12 @@ void arm_write(Server* s, Conn* c) {
 void send_reply(Server* s, Conn* c, const uint8_t* payload, uint64_t len,
                 const std::string* track_queue = nullptr) {
   size_t base = c->wbuf.size();
-  c->wbuf.resize(base + 8 + len);
+  c->wbuf.resize(base + 12 + len);
   be64(c->wbuf.data() + base, len);
-  if (len) memcpy(c->wbuf.data() + base + 8, payload, len);
+  be32(c->wbuf.data() + base + 8, crc32_ieee(payload, len));
+  if (len) memcpy(c->wbuf.data() + base + 12, payload, len);
   uint64_t begin = c->total_enqueued;
-  c->total_enqueued += 8 + len;
+  c->total_enqueued += 12 + len;
   // Tracking stores offsets only — the bytes live in wbuf; a second payload
   // copy is taken just-in-time at requeue (connection death, the rare path).
   if (track_queue) {
@@ -151,8 +193,8 @@ void close_conn(Server* s, Conn* c) {
   uint64_t wbase = c->total_enqueued - c->wbuf.size();
   for (auto it = c->inflight.rbegin(); it != c->inflight.rend(); ++it) {
     if (it->end > c->total_flushed) {
-      const uint8_t* p = c->wbuf.data() + (it->begin - wbase) + 8;
-      s->queues[it->queue].emplace_front(p, p + (it->end - it->begin - 8));
+      const uint8_t* p = c->wbuf.data() + (it->begin - wbase) + 12;
+      s->queues[it->queue].emplace_front(p, p + (it->end - it->begin - 12));
       touched.push_back(it->queue);
     }
   }
@@ -191,18 +233,26 @@ bool process_input(Server* s, Conn* c) {
     if (qlen > kMaxQueueName) return false;
     size_t header = 3 + qlen;
     uint64_t plen = 0;
+    uint32_t crc = 0;
     if (op == kOpPut) {
-      if (n < header + 8) return true;
+      if (n < header + 12) return true;
       plen = rd64(b + header);
+      crc = rd32(b + header + 8);
       if (plen > kMaxPayload) return false;
-      header += 8;
+      header += 12;
     }
     if (n < header + plen) return true;
     std::string q(reinterpret_cast<const char*>(b + 3), qlen);
 
     if (op == kOpPut) {
-      s->queues[q].emplace_back(b + header, b + header + plen);
-      pump_queue(s, q);
+      // Ingress integrity gate: a payload damaged on the sender->hub leg is
+      // dropped HERE, so a consumer can never be handed corrupt activation
+      // bytes -- the frame simply "never arrived" and the sender's timeout/
+      // failover machinery takes over.
+      if (crc32_ieee(b + header, plen) == crc) {
+        s->queues[q].emplace_back(b + header, b + header + plen);
+        pump_queue(s, q);
+      }
     } else if (op == kOpGet) {
       s->getters[q].push_back(c->fd);
       c->parked = true;

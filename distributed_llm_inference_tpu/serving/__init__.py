@@ -14,11 +14,13 @@ deadlines that cancel the underlying generation, graceful SIGTERM drain,
 """
 
 from .backends import Backend, ClientBackend, EngineBackend, Handle, TokenEvent
+from .breaker import CircuitBreaker
 from .server import ApiServer
 
 __all__ = [
     "ApiServer",
     "Backend",
+    "CircuitBreaker",
     "ClientBackend",
     "EngineBackend",
     "Handle",

@@ -72,6 +72,11 @@ class Backend:
     def queue_depth(self) -> int:
         raise NotImplementedError
 
+    def probe(self) -> bool:
+        """Cheap health check for the gateway's circuit-breaker probe
+        loop (runs on an executor thread — may block briefly)."""
+        return True
+
     def stop(self, timeout: float = 10.0) -> None:
         raise NotImplementedError
 
@@ -158,6 +163,15 @@ class EngineBackend(Backend):
     def queue_depth(self) -> int:
         return self.engine.queue_depth()
 
+    def probe(self) -> bool:
+        # The engine is local: healthy means the driver thread is alive
+        # (a dead driver strands every queued session).
+        return (
+            self._thread is not None
+            and self._thread.is_alive()
+            and not self._stop_evt.is_set()
+        )
+
     def stop(self, timeout: float = 10.0) -> None:
         self._stop_evt.set()
         self._unpaused.set()
@@ -172,7 +186,9 @@ class ClientBackend(Backend):
 
     def __init__(self, client, request_timeout_s: float = 60.0):
         self.client = client
-        self.metrics = Metrics()
+        # Share the client's Metrics when it has one: its failover /
+        # stale-reply counters then ride the gateway's /metrics for free.
+        self.metrics = getattr(client, "metrics", None) or Metrics()
         self._request_timeout_s = request_timeout_s
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._threads: Dict[str, threading.Thread] = {}
@@ -252,6 +268,17 @@ class ClientBackend(Backend):
 
     def queue_depth(self) -> int:
         return 0  # admission happens downstream, on the workers
+
+    def probe(self) -> bool:
+        # Healthy means a route covering every layer exists RIGHT NOW —
+        # this is what a submitted request would need. Raises → False:
+        # relay down, directory down, or a coverage gap all open the
+        # breaker; a replacement node registering heals it.
+        try:
+            self.client.plan_route()
+            return True
+        except Exception:  # noqa: BLE001 - any failure mode means unhealthy
+            return False
 
     def stop(self, timeout: float = 10.0) -> None:
         with self._tlock:

@@ -31,6 +31,7 @@ from typing import Optional
 
 from ..config import ServingConfig
 from .backends import Backend, Handle, TokenEvent
+from .breaker import CircuitBreaker
 from .protocol import (
     BadRequest,
     completion_chunk,
@@ -71,6 +72,14 @@ class ApiServer:
         self.backend = backend
         self.scfg = scfg or ServingConfig()
         self.tokenizer = tokenizer
+        # The breaker shares the backend's Metrics, so its state gauge and
+        # transition counters ride the same /metrics endpoint.
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.scfg.breaker_failure_threshold,
+            recovery_s=self.scfg.breaker_recovery_s,
+            success_threshold=self.scfg.breaker_success_threshold,
+            metrics=backend.metrics,
+        )
         self.port: Optional[int] = None  # bound port (scfg.port may be 0)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._shutdown: Optional[asyncio.Event] = None
@@ -96,9 +105,14 @@ class ApiServer:
         )
         self.port = server.sockets[0].getsockname()[1]
         self.backend.start(loop)
+        probe_task = None
+        if self.scfg.breaker_probe_interval_s > 0:
+            probe_task = loop.create_task(self._probe_loop())
         if ready_cb is not None:
             ready_cb(self.port)
         await self._shutdown.wait()
+        if probe_task is not None:
+            probe_task.cancel()
 
         # Graceful drain: stop accepting (close the listener — new
         # connections are refused at the TCP level), let in-flight
@@ -148,6 +162,21 @@ class ApiServer:
     def join(self, timeout: float = 60.0) -> None:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+
+    async def _probe_loop(self) -> None:
+        """Periodic backend health probe feeding the breaker. Probes run
+        in the executor — a hung backend must stall a worker thread, not
+        the accept loop."""
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.scfg.breaker_probe_interval_s)
+            try:
+                ok = await loop.run_in_executor(None, self.backend.probe)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                ok = False
+            self.breaker.record_probe(bool(ok))
 
     # -- connection handling --------------------------------------------------
 
@@ -217,6 +246,7 @@ class ApiServer:
             "status": "draining" if self._draining else "ok",
             "active_sessions": self.backend.active_sessions(),
             "queue_depth": self.backend.queue_depth(),
+            "breaker": self.breaker.state,
         }).encode()
         writer.write(_response("200 OK", body))
         await writer.drain()
@@ -241,6 +271,18 @@ class ApiServer:
             writer.write(_response(
                 "503 Service Unavailable",
                 error_body("server is draining", "server_error", "draining"),
+            ))
+            await writer.drain()
+            return
+        if not self.breaker.allow():
+            # Backend is known-bad: fail fast instead of burning a full
+            # request timeout. Retry-After points at the recovery window.
+            self.backend.metrics.counter("http_503_breaker")
+            writer.write(_response(
+                "503 Service Unavailable",
+                error_body("backend unavailable (circuit open), retry later",
+                           "server_error", "breaker_open"),
+                extra=f"Retry-After: {self.breaker.retry_after():.0f}\r\n",
             ))
             await writer.drain()
             return
@@ -278,18 +320,28 @@ class ApiServer:
         self._handles.add(handle)
         req_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
+        reason = None
         try:
             if req.stream:
-                await self._stream_completion(
+                reason = await self._stream_completion(
                     writer, req, handle, deadline, submit_t, req_id, created
                 )
             else:
-                await self._json_completion(
+                reason = await self._json_completion(
                     writer, req, handle, deadline, submit_t, req_id, created
                 )
         finally:
             self._handles.discard(handle)
             self._inflight -= 1
+            # Feed the breaker from the real outcome: only backend errors
+            # count as failures (timeouts/cancels/deadlines are request
+            # policy, not backend health; reason None means the handler
+            # itself died mid-write — neutral).
+            if reason is not None:
+                if reason.startswith("error"):
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()
 
     async def _next_event(self, handle: Handle, deadline: float,
                           first: bool, submit_t: float):
@@ -308,7 +360,7 @@ class ApiServer:
         return ev
 
     async def _json_completion(self, writer, req, handle, deadline,
-                               submit_t, req_id, created) -> None:
+                               submit_t, req_id, created) -> str:
         tokens = []
         reason = "timeout"
         while True:
@@ -329,9 +381,10 @@ class ApiServer:
         )).encode()
         writer.write(_response("200 OK", payload))
         await writer.drain()
+        return reason
 
     async def _stream_completion(self, writer, req, handle, deadline,
-                                 submit_t, req_id, created) -> None:
+                                 submit_t, req_id, created) -> str:
         writer.write(sse_headers())
         await writer.drain()
         n_tokens = 0
@@ -364,3 +417,4 @@ class ApiServer:
             self.backend.cancel(handle)
         finally:
             self.backend.metrics.counter("gateway_tokens", n_tokens)
+        return reason

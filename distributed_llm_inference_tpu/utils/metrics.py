@@ -32,10 +32,22 @@ class Metrics:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._timings: Dict[str, List[float]] = collections.defaultdict(list)
+        self._gauges: Dict[str, float] = {}
 
     def counter(self, name: str, inc: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += inc
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set a persistent gauge (last-write-wins) — for state that an
+        owner updates on transition (circuit-breaker state, pool size)
+        rather than the caller sampling it at scrape time."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
 
     @contextlib.contextmanager
     def timer(self, name: str):
@@ -67,6 +79,7 @@ class Metrics:
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
+            out.update(self._gauges)
             for name, vals in self._timings.items():
                 if not vals:
                     continue
@@ -90,7 +103,8 @@ class Metrics:
         Counters become ``<prefix>_<name>_total`` counters; timings become
         ``<prefix>_<name>_seconds`` summaries (p50/p99 quantiles + _sum +
         _count); ``extra_gauges`` are point-in-time gauges (queue depth,
-        active sessions) sampled by the caller."""
+        active sessions) sampled by the caller and merged over the
+        persistent ``gauge()`` values."""
 
         def clean(name: str) -> str:
             return _PROM_NAME.sub("_", f"{prefix}_{name}")
@@ -98,6 +112,8 @@ class Metrics:
         with self._lock:
             counters = dict(self._counters)
             timings = {k: list(v) for k, v in self._timings.items()}
+            gauges = dict(self._gauges)
+        gauges.update(extra_gauges or {})
         lines: List[str] = []
         for name in sorted(counters):
             metric = clean(name) + "_total"
@@ -115,8 +131,8 @@ class Metrics:
             lines.append(f'{metric}{{quantile="0.99"}} {p99:.10g}')
             lines.append(f"{metric}_sum {sum(vals):.10g}")
             lines.append(f"{metric}_count {len(vals)}")
-        for name in sorted(extra_gauges or {}):
+        for name in sorted(gauges):
             metric = clean(name)
             lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {extra_gauges[name]:.10g}")
+            lines.append(f"{metric} {gauges[name]:.10g}")
         return "\n".join(lines) + "\n"

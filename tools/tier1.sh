@@ -6,6 +6,11 @@
 #
 # DOTS_PASSED counts pytest progress dots (passes) in the captured log —
 # the cross-PR comparison metric.
+#
+# The chaos-lite subset (tests/test_chaos.py minus its 'slow' cases —
+# seeded FaultPlan schedules, fast multi-node fault drills) is part of
+# this tier: the '-m not slow' selection below picks it up because the
+# chaos tests are marked 'chaos' but only the long soak cases are 'slow'.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
