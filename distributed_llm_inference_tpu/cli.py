@@ -334,7 +334,11 @@ def cmd_api(args) -> int:
         client = DistributedClient(
             port, cfg, params, host=host, dtype=jnp.dtype(args.dtype)
         )
-        backend = ClientBackend(client, request_timeout_s=args.timeout)
+        backend = ClientBackend(
+            client, request_timeout_s=args.timeout,
+            batch_max=args.client_batch,
+            batch_window_s=args.client_batch_window,
+        )
     else:
         from .engine.engine import InferenceEngine
 
@@ -534,6 +538,14 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--relay", default=None,
                    help="host:port of a relay: serve through the "
                         "distributed chain instead of a local engine")
+    a.add_argument("--client-batch", type=int, default=0,
+                   help="with --relay: group up to N admitted requests "
+                        "into one batched decode loop (generate_many) so "
+                        "they share stacked frames and device calls; 0 = "
+                        "one generation per thread")
+    a.add_argument("--client-batch-window", type=float, default=0.01,
+                   help="seconds the request collector lingers from the "
+                        "first admitted request of a group")
     a.add_argument("--tokenizer", default=None,
                    help="tokenizer checkpoint dir: enables string prompts "
                         "and decoded text in responses")

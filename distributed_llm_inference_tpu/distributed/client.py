@@ -48,6 +48,25 @@ class WorkerError(RuntimeError):
         self.retryable = retryable
 
 
+class _Row:
+    """Per-prompt state for :meth:`DistributedClient.generate_many` —
+    persists across failover attempts (``out`` is the replay source)."""
+
+    __slots__ = ("index", "prompt", "out", "opts", "max_new", "eos", "key",
+                 "done", "reason")
+
+    def __init__(self, index, prompt, opts, max_new, eos, key):
+        self.index = index
+        self.prompt = prompt
+        self.out: List[int] = []
+        self.opts = opts
+        self.max_new = max_new
+        self.eos = eos
+        self.key = key
+        self.done = False
+        self.reason: Optional[str] = None
+
+
 class DistributedClient:
     """Routes generations through remote block workers.
 
@@ -64,6 +83,7 @@ class DistributedClient:
         host: str = "127.0.0.1",
         prefill_buckets: Sequence[int] = (32, 128, 512),
         dtype=jnp.bfloat16,
+        max_pooled_connections: int = 4,
     ):
         self.cfg = cfg
         self.params = params
@@ -73,9 +93,14 @@ class DistributedClient:
         # The directory connection is shared across concurrent generations
         # (its request/reply pairs must not interleave); relay connections
         # are per-generation (each owns its reply queue), which is what
-        # makes N in-flight generations per client instance safe.
+        # makes N in-flight generations per client instance safe. Idle
+        # connections are pooled and reused across attempts/generations —
+        # ``connections_opened`` counts actual dials, not attempts.
         self._directory = DirectoryClient(relay_port, host)
         self._dir_lock = threading.Lock()
+        self._conn_pool: List[RelayClient] = []
+        self._conn_lock = threading.Lock()
+        self._max_pooled = max_pooled_connections
         self.failovers = 0  # mid-generation re-route count (observability)
         self.metrics = Metrics()  # /metrics surface for chaos observability
 
@@ -95,6 +120,50 @@ class DistributedClient:
             return sample(logits[:, 0], key, sp)
 
         self._sample_last = jax.jit(_sample_last)
+
+        # Batched (generate_many) variants: one device call over the whole
+        # stack of active rows, with per-row last-position gather.
+        def _head_rows(params, x, idx):
+            last = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+            return llama.apply_head(self.cfg, params, last)  # [A, 1, V]
+
+        self._head_rows = jax.jit(_head_rows)
+
+        def _sample_rows(params, x, idx, keys, steps, temps, tks, tps):
+            logits = _head_rows(params, x, idx)[:, 0]  # [A, V]
+            # vmap of the SERIAL per-row computation — each row samples a
+            # [1, V] slice under its own folded key, so tokens are
+            # byte-identical to N independent generate() calls (one shared
+            # key over [A, V] would draw a different stream per row).
+            def one(lg, k, st, t, tk, tp):
+                sp = SamplingParams(
+                    temperature=t[None], top_k=tk[None], top_p=tp[None],
+                    all_greedy=False,
+                )
+                return sample(lg[None], jax.random.fold_in(k, st), sp)[0]
+
+            return jax.vmap(one)(logits, keys, steps, temps, tks, tps)
+
+        self._sample_rows = jax.jit(_sample_rows)
+
+    # -- relay connection pool -------------------------------------------------
+
+    def _acquire_relay(self) -> RelayClient:
+        with self._conn_lock:
+            if self._conn_pool:
+                return self._conn_pool.pop()
+        self.metrics.counter("connections_opened")
+        return RelayClient(self.host, self.relay_port)
+
+    def _release_relay(self, relay: RelayClient) -> None:
+        """Return a connection that finished an attempt CLEANLY (no
+        outstanding GET, reply queue retired) to the pool; error paths must
+        close instead — a half-read stream would desync the next user."""
+        with self._conn_lock:
+            if len(self._conn_pool) < self._max_pooled:
+                self._conn_pool.append(relay)
+                return
+        relay.close()
 
     # -- routing --------------------------------------------------------------
 
@@ -233,15 +302,18 @@ class DistributedClient:
         key = jax.random.PRNGKey(seed)
         while True:
             relay = None
+            clean = False
             try:
                 # Inside the try: a relay outage at attempt start (the
                 # control-plane-restart case) must count as a retried
                 # failover, not escape to the caller.
-                relay = RelayClient(self.host, self.relay_port)
-                return self._generate_attempt(
+                relay = self._acquire_relay()
+                result = self._generate_attempt(
                     relay, list(prompt), out, max_new_tokens, eos_token_id,
                     timeout, opts, key, on_token, stop_check,
                 )
+                clean = True
+                return result
             except (TimeoutError, RuntimeError, ConnectionError, OSError) as e:
                 # Besides timeouts and worker errors, a relay/control-plane
                 # restart surfaces as a connection error mid-hop — that is a
@@ -258,7 +330,12 @@ class DistributedClient:
                 self._await_route(time.monotonic() + reroute_wait)
             finally:
                 if relay is not None:
-                    relay.close()
+                    # Only a cleanly finished attempt may be reused: a
+                    # failed one can have a stray reply in flight.
+                    if clean:
+                        self._release_relay(relay)
+                    else:
+                        relay.close()
 
     def _prefill_chunks(self, relay, route, gen_id, tokens, timeout,
                         reply_queue):
@@ -343,8 +420,338 @@ class DistributedClient:
         finally:
             self._end_session(relay, route, gen_id)
 
+    # -- batched generation (generate_many) ------------------------------------
+    #
+    # N prompts decoded in LOCKSTEP over one relay connection and one reply
+    # queue: the hidden states of every active row travel as a single
+    # stacked ``[A, S, H]`` frame per hop (co-batched at the SOURCE, so the
+    # chain runs one device call per hop regardless of pool-window luck),
+    # and the client runs one jitted embed/head/sample call over the whole
+    # stack. Rows that hit EOS / their token budget / a stop signal drop
+    # out of the stack without stalling the rest.
+
+    def generate_many(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens=16,
+        eos_token_id: Optional[int] = None,
+        timeout: float = 60.0,
+        max_retries: int = 2,
+        reroute_wait: float = 15.0,
+        options=None,
+        seeds: Optional[Sequence[int]] = None,
+        on_token: Optional[Callable[[int, int], None]] = None,
+        stop_check: Optional[Callable[[int], bool]] = None,
+        on_finish: Optional[Callable[[int, str], None]] = None,
+    ) -> List[List[int]]:
+        """Decode ``prompts`` together; returns one token list per prompt,
+        byte-identical to N serial :meth:`generate` calls at the same seeds
+        (per-row sampling keys fold the token index exactly as the serial
+        path does; greedy rows take the same argmax).
+
+        ``max_new_tokens`` / ``options`` / ``seeds`` may be a single value
+        or one per row. ``on_token(row, token)`` fires once per FRESH token;
+        ``stop_check(row)`` abandons that row when True; ``on_finish(row,
+        reason)`` reports ``eos`` / ``length`` / ``stopped`` / ``error: …``.
+
+        Failover is cohort-wide: a lost hop (timeout / retryable worker
+        error / relay restart) replays every unfinished row on a fresh
+        route under fresh generation ids — finished rows and already-
+        emitted tokens are untouched. A non-retryable error on one row
+        drops only that row (its tokens so far are returned); the rest of
+        the stack decodes on.
+        """
+        n = len(prompts)
+        if n == 0:
+            return []
+        for p in prompts:
+            if not len(p):
+                raise ValueError("empty prompt")
+        max_news = (list(max_new_tokens)
+                    if isinstance(max_new_tokens, (list, tuple))
+                    else [max_new_tokens] * n)
+        opt_list = (list(options) if isinstance(options, (list, tuple))
+                    else [options] * n)
+        seed_list = (list(seeds) if seeds is not None else [0] * n)
+        rows = []
+        for i in range(n):
+            opts = opt_list[i] or SamplingOptions()
+            eos = eos_token_id
+            if eos is None and opts.eos_token_id >= 0:
+                eos = opts.eos_token_id
+            rows.append(_Row(i, list(prompts[i]), opts, max_news[i], eos,
+                             jax.random.PRNGKey(seed_list[i])))
+        failures = 0
+        while True:
+            relay = None
+            clean = False
+            try:
+                relay = self._acquire_relay()
+                self._generate_many_attempt(
+                    relay, rows, timeout, on_token, stop_check, on_finish
+                )
+                clean = True
+                return [r.out for r in rows]
+            except (TimeoutError, RuntimeError, ConnectionError, OSError) as e:
+                if isinstance(e, WorkerError) and not e.retryable:
+                    raise
+                failures += 1
+                self.failovers += 1
+                self.metrics.counter("failovers")
+                if failures > max_retries:
+                    raise
+                if stop_check is not None and all(
+                    r.done or stop_check(r.index) for r in rows
+                ):
+                    return [r.out for r in rows]
+                self._await_route(time.monotonic() + reroute_wait)
+            finally:
+                if relay is not None:
+                    if clean:
+                        self._release_relay(relay)
+                    else:
+                        relay.close()
+
+    def _generate_many_attempt(self, relay, rows, timeout, on_token,
+                               stop_check, on_finish) -> None:
+        """One route's worth of lockstep progress; row state (``out``)
+        persists across attempts exactly like the serial path's."""
+
+        def finish(row, reason):
+            row.done = True
+            row.reason = reason
+            if on_finish is not None:
+                on_finish(row.index, reason)
+
+        def check_done(row):
+            if row.out[-1] == row.eos:
+                finish(row, "eos")
+            elif len(row.out) >= row.max_new:
+                finish(row, "length")
+
+        for row in rows:  # the failed hop may have been past the last token
+            if not row.done and row.out:
+                check_done(row)
+        active = [r for r in rows if not r.done]
+        if not active:
+            return
+        route = self.plan_route()
+        gen_ids = {r.index: f"gen-{uuid.uuid4().hex[:12]}" for r in active}
+        reply_queue = f"client.{uuid.uuid4().hex[:12]}"
+        ended: set = set()
+        try:
+            seq, ys, lens = self._prefill_many_rows(
+                relay, route, active, gen_ids, timeout, reply_queue, finish
+            )
+            fresh = [r for r in active if not r.done and not r.out]
+            if fresh:
+                toks = self._next_tokens_rows(
+                    [ys[r.index] for r in fresh],
+                    [lens[r.index] - 1 for r in fresh], fresh,
+                )
+                for r, t in zip(fresh, toks):
+                    r.out.append(t)
+                    if on_token is not None:
+                        on_token(r.index, t)
+                    check_done(r)
+            self._end_gens(relay, route,
+                           [gen_ids[r.index] for r in active if r.done],
+                           ended)
+            while True:
+                live = [r for r in active if not r.done]
+                if stop_check is not None:
+                    for r in live:
+                        if stop_check(r.index):
+                            finish(r, "stopped")
+                    live = [r for r in live if not r.done]
+                if not live:
+                    return
+                x = self._embed(
+                    self.params["embed"],
+                    jnp.asarray([[r.out[-1]] for r in live], jnp.int32),
+                )
+                gens = [gen_ids[r.index] for r in live]
+                self._send_stacked(relay, route, gens, [1] * len(live),
+                                   np.asarray(x), False, seq, reply_queue)
+                results = self._collect_stacked(relay, reply_queue, gens,
+                                                seq, timeout)
+                seq += 1
+                ok_rows, ys_list = [], []
+                for r in live:
+                    res = results[gen_ids[r.index]]
+                    if isinstance(res, Exception):
+                        self.metrics.counter("row_errors")
+                        finish(r, f"error: {res}")
+                    else:
+                        ok_rows.append(r)
+                        ys_list.append(res)
+                if ok_rows:
+                    toks = self._next_tokens_rows(
+                        ys_list, [0] * len(ok_rows), ok_rows
+                    )
+                    for r, t in zip(ok_rows, toks):
+                        r.out.append(t)
+                        if on_token is not None:
+                            on_token(r.index, t)
+                        check_done(r)
+                # Early leavers free their cache rows now, not at cohort end.
+                self._end_gens(relay, route,
+                               [gen_ids[r.index] for r in active if r.done],
+                               ended)
+        finally:
+            self._end_gens(relay, route, list(gen_ids.values()), ended)
+
+    def _prefill_many_rows(self, relay, route, rows, gen_ids, timeout,
+                           reply_queue, finish):
+        """Chunked replay prefill for the whole cohort: each round groups
+        rows by bucket and sends one stacked frame per group (pipelined —
+        replies for a round are collected together). Returns ``(next hop
+        seq, {row: last chunk's hidden states}, {row: last valid pos+1})``.
+        """
+        cap = self.prefill_buckets[-1]
+        chunks = {}
+        for r in rows:
+            replay = r.prompt + r.out[:-1]
+            chunks[r.index] = [replay[i : i + cap]
+                               for i in range(0, len(replay), cap)]
+        ys, lens = {}, {}
+        seq = 0
+        for ci in range(max(len(c) for c in chunks.values())):
+            todo = [r for r in rows
+                    if not r.done and ci < len(chunks[r.index])]
+            if not todo:
+                break
+            groups = {}
+            for r in todo:
+                b = self._bucket(len(chunks[r.index][ci]))
+                groups.setdefault(b, []).append(r)
+            expected = []
+            for b in sorted(groups):
+                grp = groups[b]
+                padded = np.zeros((len(grp), b), np.int32)
+                nns = []
+                for gi, r in enumerate(grp):
+                    ch = chunks[r.index][ci]
+                    padded[gi, : len(ch)] = np.asarray(ch, np.int32)
+                    nns.append(len(ch))
+                x = self._embed(self.params["embed"], jnp.asarray(padded))
+                gens = [gen_ids[r.index] for r in grp]
+                self._send_stacked(relay, route, gens, nns, np.asarray(x),
+                                   ci == 0, seq, reply_queue)
+                expected.extend(gens)
+            results = self._collect_stacked(relay, reply_queue, expected,
+                                            seq, timeout)
+            seq += 1
+            for grp in groups.values():
+                for r in grp:
+                    res = results[gen_ids[r.index]]
+                    if isinstance(res, Exception):
+                        self.metrics.counter("row_errors")
+                        finish(r, f"error: {res}")
+                    else:
+                        ys[r.index] = res
+                        lens[r.index] = len(chunks[r.index][ci])
+        return seq, ys, lens
+
+    def _send_stacked(self, relay, route, gens, num_new, x, new, seq,
+                      reply_queue) -> None:
+        hops = [n["queue"] for n in route[1:]] + [reply_queue]
+        header = {"op": "forward", "gens": list(gens),
+                  "num_new": [int(v) for v in num_new],
+                  "hops": hops, "new": bool(new), "seq": seq}
+        relay.put(route[0]["queue"], pack_frame(header, np.asarray(x)))
+
+    def _collect_stacked(self, relay, reply_queue, gens, seq, timeout):
+        """Collect replies until every generation in ``gens`` is accounted
+        for. Returns {gen_id: [1, S, H] row} — or a non-retryable
+        WorkerError for rows a worker rejected deterministically (retryable
+        errors raise: session loss means the whole cohort fails over)."""
+        pending = set(gens)
+        results: Dict[str, object] = {}
+        deadline = time.monotonic() + timeout
+        while pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no reply for {len(pending)} generations hop seq={seq} "
+                    f"within {timeout}s"
+                )
+            header, y = unpack_frame(relay.get(reply_queue, timeout=remaining))
+            if header.get("op") == "error":
+                code = header.get("code")
+                retryable = (
+                    code == "unknown_generation" if code is not None
+                    else "unknown generation" in header.get("error", "")
+                )
+                err = WorkerError(
+                    f"worker {header.get('from')}: {header.get('error')}",
+                    retryable=retryable,
+                )
+                if retryable:
+                    raise err
+                gid = header.get("gen_id")
+                if gid in pending:
+                    results[gid] = err
+                    pending.discard(gid)
+                continue
+            rseq = header.get("seq")
+            if rseq is not None and rseq != seq:
+                self.metrics.counter("stale_replies_discarded")
+                continue
+            rgens = header.get("gens")
+            if rgens is None:
+                rgens, rows = [header.get("gen_id")], [y]
+            else:
+                rows = [y[i : i + 1] for i in range(len(rgens))]
+            matched = False
+            for gid, row in zip(rgens, rows):
+                if gid in pending:
+                    results[gid] = np.asarray(row)
+                    pending.discard(gid)
+                    matched = True
+            if not matched:  # duplicated delivery of this hop's reply
+                self.metrics.counter("stale_replies_discarded")
+        return results
+
+    def _next_tokens_rows(self, ys, idxs, rows) -> List[int]:
+        """One jitted head (+ per-row-keyed sample) call over the stacked
+        rows — ``ys`` are ``[1, S, H]`` slices of equal S. Greedy-only
+        stacks skip the RNG entirely, like the serial path."""
+        x = jnp.asarray(np.concatenate([np.asarray(y) for y in ys], axis=0))
+        idx = jnp.asarray(idxs, jnp.int32)
+        if all(r.opts.temperature <= 0.0 for r in rows):
+            logits = self._head_rows(self.params, x, idx)
+            return [int(t) for t in
+                    np.asarray(jnp.argmax(logits[:, -1], axis=-1))]
+        toks = self._sample_rows(
+            self.params, x, idx,
+            jnp.stack([r.key for r in rows]),
+            jnp.asarray([len(r.out) for r in rows], jnp.int32),
+            jnp.asarray([r.opts.temperature for r in rows], jnp.float32),
+            jnp.asarray([r.opts.top_k for r in rows], jnp.int32),
+            jnp.asarray([r.opts.top_p for r in rows], jnp.float32),
+        )
+        return [int(t) for t in np.asarray(toks)]
+
+    def _end_gens(self, relay, route, gids, ended) -> None:
+        """Best-effort session teardown for a batch of generations: ONE
+        pipelined send carries an ``end`` frame to every route node."""
+        fresh = [g for g in gids if g not in ended]
+        if not fresh:
+            return
+        ended.update(fresh)
+        frame = pack_frame({"op": "end", "gens": fresh})
+        try:
+            relay.put_many([(node["queue"], frame) for node in route])
+        except Exception:
+            pass
+
     def close(self) -> None:
         self._directory.close()
+        with self._conn_lock:
+            pool, self._conn_pool = self._conn_pool, []
+        for relay in pool:
+            relay.close()
 
     def __enter__(self):
         return self

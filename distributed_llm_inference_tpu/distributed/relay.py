@@ -215,6 +215,27 @@ class RelayClient:
             self._reconnect()
             raise
 
+    def put_many(self, items) -> None:
+        """Pipelined PUT: encode every ``(queue, payload)`` frame and ship
+        them in ONE ``sendall`` — a node's whole fan-out of replies costs a
+        single syscall, and the hub parses back-to-back frames straight off
+        the stream (its ``process_input`` already loops over complete
+        frames, so no protocol change is needed).
+
+        Same no-resend contract as :meth:`put`: on a connection error the
+        whole group is treated as lost (any prefix may have been applied, so
+        resending could double-apply hops); callers fail over / replay.
+        """
+        self._require_open()
+        data = b"".join(self._encode_put(q, p) for q, p in items)
+        if not data:
+            return
+        try:
+            self._sock.sendall(data)
+        except (ConnectionError, OSError):
+            self._reconnect()
+            raise
+
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
         while n:

@@ -7,14 +7,31 @@ concurrent RPC requests for the module; its own ``server/task_pool.py`` is an
 replacement: a thread that drains a queue, groups compatible requests (same
 shape signature) up to ``max_batch`` within ``window_s``, and runs them in one
 call — submitters block on per-request futures.
+
+Scheduling contract (the continuous-batching fix):
+
+* Everything already queued is drained greedily (``get_nowait``) — a full
+  queue dispatches with ZERO added latency.
+* The linger window is a single deadline measured from the FIRST item of the
+  batch, never one ``window_s`` per empty poll: worst-case added latency per
+  batch is ``window_s``, not ``(max_batch - 1) * window_s``.
+* Reaching ``max_batch`` dispatches immediately, deadline or not.
+* Items whose signature doesn't match the batch being formed are deferred to
+  a local list that is consumed BEFORE newly arrived queue items on later
+  rounds — mixed ``end``/``fwd`` traffic can't starve either kind.
+* ``submit(item, eager=True)`` marks an item as already-batched (e.g. a
+  stacked multi-generation frame co-batched at the source): once the queue
+  is drained, a batch containing any eager item dispatches immediately
+  instead of lingering for stragglers that aren't coming.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 __all__ = ["TaskPool"]
 
@@ -24,6 +41,9 @@ class TaskPool:
 
     ``signature(item)`` keys compatibility — only items with equal signatures
     are batched together (e.g. decode steps vs differently-bucketed prefills).
+    ``metrics`` (a ``utils.metrics.Metrics``), when given, records a
+    ``pool_batch_occupancy`` histogram plus per-size counters so the serving
+    tier can see how full its device calls actually run.
     """
 
     def __init__(
@@ -33,53 +53,84 @@ class TaskPool:
         window_s: float = 0.002,
         signature: Callable[[Any], Any] = lambda item: None,
         name: str = "task_pool",
+        metrics=None,
     ):
         self.fn = fn
         self.max_batch = max_batch
         self.window_s = window_s
         self.signature = signature
         self.name = name
-        self._queue: "queue.Queue[Tuple[Any, Future]]" = queue.Queue()
+        self.metrics = metrics
+        self._queue: "queue.Queue[Tuple[Any, Future, bool]]" = queue.Queue()
+        # Incompatible items parked during earlier rounds; owned by the loop
+        # thread, consumed before new arrivals (fairness).
+        self._deferred: List[Tuple[Any, Future, bool]] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=name)
         self._thread.start()
 
-    def submit(self, item: Any) -> Future:
+    def submit(self, item: Any, eager: bool = False) -> Future:
+        """``eager`` marks an item that is already a batch in itself; its
+        presence lets the dispatch loop skip the linger once the queue is
+        empty."""
         if self._stop.is_set():
             raise RuntimeError(f"{self.name} is stopped")
         fut: Future = Future()
-        self._queue.put((item, fut))
+        self._queue.put((item, fut, eager))
         return fut
 
     def __call__(self, item: Any, timeout: float = 60.0) -> Any:
         return self.submit(item).result(timeout)
 
+    def _take_deferred(self, sig) -> Optional[Tuple[Any, Future]]:
+        for i, item in enumerate(self._deferred):
+            if self.signature(item[0]) == sig:
+                return self._deferred.pop(i)
+        return None
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
+            if self._deferred:
+                first = self._deferred.pop(0)  # oldest parked group first
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
             batch = [first]
             sig = self.signature(first[0])
-            deferred: List[Tuple[Any, Future]] = []
-            # Linger up to window_s for compatible co-batchable requests.
-            while len(batch) < self.max_batch:
-                try:
-                    item = self._queue.get(timeout=self.window_s)
-                except queue.Empty:
-                    break
-                if self.signature(item[0]) == sig:
-                    batch.append(item)
-                else:
-                    deferred.append(item)
-            for item in deferred:  # incompatible: back for the next round
-                self._queue.put(item)
+            eager = first[2]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch and not self._stop.is_set():
+                item = self._take_deferred(sig)
+                if item is None:
+                    try:
+                        item = self._queue.get_nowait()  # greedy drain
+                    except queue.Empty:
+                        # An eager member means this batch was co-batched at
+                        # the source — nothing to linger for.
+                        if eager:
+                            break
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        try:
+                            item = self._queue.get(timeout=remaining)
+                        except queue.Empty:
+                            break
+                    if self.signature(item[0]) != sig:
+                        self._deferred.append(item)
+                        continue
+                eager = eager or item[2]
+                batch.append(item)
+            if self.metrics is not None:
+                self.metrics.observe("pool_batch_occupancy", len(batch))
+                self.metrics.counter(f"pool_batches_size_{len(batch)}")
             self._run(batch)
 
-    def _run(self, batch: List[Tuple[Any, Future]]) -> None:
-        items = [item for item, _ in batch]
+    def _run(self, batch: List[Tuple[Any, Future, bool]]) -> None:
+        items = [entry[0] for entry in batch]
         try:
             results = self.fn(items)
             if len(results) != len(items):
@@ -87,24 +138,27 @@ class TaskPool:
                     f"{self.name}: fn returned {len(results)} results for "
                     f"{len(items)} items"
                 )
-            for (_, fut), res in zip(batch, results):
-                fut.set_result(res)
+            for entry, res in zip(batch, results):
+                entry[1].set_result(res)
         except Exception as e:
-            for _, fut in batch:
-                if not fut.done():
-                    fut.set_exception(e)
+            for entry in batch:
+                if not entry[1].done():
+                    entry[1].set_exception(e)
 
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
-        # Fail anything still queued so submitters don't hang.
+        # Fail anything still queued or parked so submitters don't hang.
+        leftovers = list(self._deferred)
+        self._deferred = []
         while True:
             try:
-                _, fut = self._queue.get_nowait()
+                leftovers.append(self._queue.get_nowait())
             except queue.Empty:
                 break
-            if not fut.done():
-                fut.set_exception(RuntimeError(f"{self.name} stopped"))
+        for entry in leftovers:
+            if not entry[1].done():
+                entry[1].set_exception(RuntimeError(f"{self.name} stopped"))
 
     def __enter__(self):
         return self

@@ -25,6 +25,8 @@ import traceback
 import uuid
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..config import ModelConfig
 from .backend import BlockBackend, SchemaError
 from .directory import DirectoryClient
@@ -89,6 +91,9 @@ class ServingNode:
         # at-least-once transport (duplicated PUT) must not advance a
         # session's KV cache twice — the duplicate is skipped, no reply.
         self._applied_seq: Dict[str, int] = {}
+        # Prune threshold precomputed once: the per-batch check is a bare
+        # len() compare, and small dicts are never scanned at all.
+        self._seq_prune_at = 4 * max_sessions + 16
 
         # Register FIRST: a directory/relay failure here must not leak the
         # pool thread or relay sockets (there is no node object to stop()).
@@ -114,7 +119,7 @@ class ServingNode:
             self._pool = TaskPool(
                 self._process_batch, max_batch=pool_max_batch or max_sessions,
                 window_s=batch_window_s, signature=lambda item: item[0],
-                name=f"{self.node_id}.pool",
+                name=f"{self.node_id}.pool", metrics=self.metrics,
             )
         except Exception:
             self._out.close()
@@ -148,7 +153,8 @@ class ServingNode:
                     return
                 if op == "end":
                     # Through the pool so backend state stays single-threaded.
-                    self._pool.submit((("end",), header, None))
+                    self._pool.submit((("end",), header, None),
+                                      eager=bool(header.get("gens")))
                     continue
                 if op != "forward":
                     continue
@@ -156,12 +162,20 @@ class ServingNode:
                     continue  # nowhere to reply or report to — drop
                 # Group key: hops of equal padded length batch together
                 # (decode steps with decode steps, like-bucketed prefills
-                # with each other). Malformed payloads (missing / wrong-rank
-                # tensor) get a degenerate key and fail per-item in
-                # backend.validate → error reply, never the consume loop.
+                # with each other). Stacked multi-generation frames
+                # (``gens`` header, ``[N, S, H]`` payload) share the key
+                # space — axis 1 is the padded length for both layouts, so
+                # a stacked decode frame co-batches with single decode hops.
+                # Malformed payloads (missing / wrong-rank tensor) get a
+                # degenerate key and fail per-item in backend.validate →
+                # error reply, never the consume loop.
                 shape = getattr(arr, "shape", ())
                 s_key = shape[1] if len(shape) >= 2 else -1
-                self._pool.submit((("fwd", s_key), header, arr))
+                # Stacked frames were co-batched at the source: dispatching
+                # them without the linger is what keeps the lockstep decode
+                # loop's per-hop cost at compute + transit, not + window_s.
+                self._pool.submit((("fwd", s_key), header, arr),
+                                  eager=bool(header.get("gens")))
         except (ConnectionError, OSError):
             return  # relay gone: health loop will notice / tests tear down
         except Exception:
@@ -175,57 +189,100 @@ class ServingNode:
     def _process_batch(self, items) -> List[None]:
         """Task-pool fn: one batch of same-signature frames → one backend
         call; replies/errors go straight back over the relay (futures are
-        fire-and-forget)."""
+        fire-and-forget).
+
+        A frame is either a single hop (``gen_id`` header, ``[1, S, H]``
+        payload) or a stacked multi-generation hop from a batched client
+        (``gens``/``num_new`` lists, ``[N, S, H]`` payload). Stacked frames
+        flatten into the same ``forward_many`` group as the singles —
+        everything in the pool batch runs as ONE backend call — and each
+        stacked frame is re-stacked into one reply (failed rows peel off as
+        individual error frames). All replies for the batch then leave in
+        one pipelined ``put_many`` (a single syscall for the whole fan-out).
+        """
         try:
             if items[0][0] == ("end",):
                 for _, header, _ in items:
-                    gid = header.get("gen_id", "")
-                    self.backend.end(gid)
-                    self._applied_seq.pop(gid, None)
+                    for gid in header.get("gens") or [header.get("gen_id", "")]:
+                        self.backend.end(gid)
+                        self._applied_seq.pop(gid, None)
                 return [None] * len(items)
-            # Hop-seq dedup (pool thread serializes, so no lock): a frame
-            # whose seq this node already applied is a duplicated delivery —
-            # skip it with NO reply (the original's reply already went out;
-            # a second reply would itself be a duplicate downstream).
-            fresh = []
-            for item in items:
-                _, h, _ = item
-                seq, gid = h.get("seq"), h.get("gen_id", "")
-                if seq is not None:
-                    last = self._applied_seq.get(gid)
-                    if last is not None and seq <= last:
-                        self.metrics.counter("duplicate_hops_skipped")
-                        continue
-                    self._applied_seq[gid] = seq
-                fresh.append(item)
-            if len(self._applied_seq) > 4 * self.backend.max_sessions + 16:
+            # Flatten every frame into per-generation rows, with hop-seq
+            # dedup (pool thread serializes, so no lock): a row whose seq
+            # this node already applied is a duplicated delivery — skip it
+            # with NO reply (the original's reply already went out; a second
+            # reply would itself be a duplicate downstream).
+            reqs = []    # flattened forward_many items
+            frames = []  # (header, rows) — rows: (req_idx | None, gid, nn)
+            for _, header, arr in items:
+                gens = header.get("gens")
+                if gens is not None:
+                    metas = list(zip(gens, header.get("num_new") or []))
+                else:
+                    metas = [(header.get("gen_id", ""),
+                              header.get("num_new", 0))]
+                seq = header.get("seq")
+                new = bool(header.get("new", False))
+                rows = []
+                for i, (gid, nn) in enumerate(metas):
+                    if seq is not None:
+                        last = self._applied_seq.get(gid)
+                        if last is not None and seq <= last:
+                            self.metrics.counter("duplicate_hops_skipped")
+                            rows.append((None, gid, nn))
+                            continue
+                        self._applied_seq[gid] = seq
+                    x = arr[i : i + 1] if gens is not None else arr
+                    rows.append((len(reqs), gid, nn))
+                    reqs.append((gid, x, nn, new))
+                frames.append((header, rows))
+            if len(self._applied_seq) > self._seq_prune_at:
                 # "end" frames are best-effort, so entries can leak; prune
                 # against the backend's live session table.
                 live = self.backend.sessions
                 self._applied_seq = {
                     g: s for g, s in self._applied_seq.items() if g in live
                 }
-            if not fresh:
-                return [None] * len(items)
-            reqs = [
-                (h.get("gen_id", ""), arr, h.get("num_new", 0),
-                 bool(h.get("new", False)))
-                for _, h, arr in fresh
-            ]
-            outs = self.backend.forward_many(reqs)
-            for (_, header, _), y in zip(fresh, outs):
+            outs = self.backend.forward_many(reqs) if reqs else []
+            # Invariant reply fields computed once per batch, not per item.
+            node = self.node_id
+            shipments = []  # (queue, frame bytes) for ONE pipelined send
+            for header, rows in frames:
                 hops = header.get("hops") or []
-                if isinstance(y, Exception):
-                    # Protocol/session errors go back to the client's reply
-                    # queue (last hop) so generate() fails fast instead of
-                    # hanging.
-                    err = {"op": "error", "gen_id": header.get("gen_id"),
-                           "error": f"{type(y).__name__}: {y}",
-                           "code": error_code(y), "from": self.node_id}
-                    self._out.put(hops[-1], pack_frame(err))
+                fresh = [(ri, gid, nn) for ri, gid, nn in rows
+                         if ri is not None]
+                if not fresh or not hops:
+                    continue  # wholly-duplicated frame: no reply
+                ok_rows = []
+                for ri, gid, nn in fresh:
+                    y = outs[ri]
+                    if isinstance(y, Exception):
+                        # Protocol/session errors go back to the client's
+                        # reply queue (last hop) so generate() fails fast
+                        # instead of hanging; surviving rows of a stacked
+                        # frame still travel on below.
+                        err = {"op": "error", "gen_id": gid,
+                               "error": f"{type(y).__name__}: {y}",
+                               "code": error_code(y), "from": node}
+                        shipments.append((hops[-1], pack_frame(err)))
+                    else:
+                        ok_rows.append((gid, nn, y))
+                if not ok_rows:
+                    continue
+                if header.get("gens") is not None:
+                    reply = {"op": "forward",
+                             "gens": [g for g, _, _ in ok_rows],
+                             "num_new": [n for _, n, _ in ok_rows],
+                             "new": header.get("new", False),
+                             "seq": header.get("seq"),
+                             "hops": hops[1:], "from": node}
+                    y = np.concatenate([y for _, _, y in ok_rows], axis=0)
                 else:
-                    reply = {**header, "hops": hops[1:], "from": self.node_id}
-                    self._out.put(hops[0], pack_frame(reply, y))
+                    reply = {**header, "hops": hops[1:], "from": node}
+                    y = ok_rows[0][2]
+                shipments.append((hops[0], pack_frame(reply, y)))
+            if shipments:
+                self._out.put_many(shipments)
             return [None] * len(items)
         except (ConnectionError, OSError):
             return [None] * len(items)  # relay gone mid-reply: teardown

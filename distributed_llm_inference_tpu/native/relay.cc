@@ -32,6 +32,12 @@
 //   Multiple concurrent GETs on one queue are served FIFO. A connection that
 //   dies while parked requeues any reply it never received.
 //
+//   PUTs may be PIPELINED: a client can concatenate any number of complete
+//   PUT frames into one TCP send (RelayClient.put_many) and the hub applies
+//   them in order -- process_input() loops over every complete frame in the
+//   read buffer, so a node's whole fan-out of replies costs one syscall on
+//   each side. No new opcode: pipelining is a property of the stream.
+//
 // Exposed as a C API (relay_start / relay_stop) so Python drives it via
 // ctypes -- no pybind11 in this image. Clients speak the socket protocol
 // directly (distributed_llm_inference_tpu/distributed/relay.py).

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import queue
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -182,27 +183,53 @@ class EngineBackend(Backend):
 class ClientBackend(Backend):
     """Relay-tier backend: one worker thread per in-flight generation
     (the relay hop IS the batching point — workers co-batch sessions on
-    their task pools, so per-request client threads don't serialize)."""
+    their task pools, so per-request client threads don't serialize).
 
-    def __init__(self, client, request_timeout_s: float = 60.0):
+    With ``batch_max > 1`` admitted requests instead feed the client's
+    BATCHED decode loop: a collector groups up to ``batch_max`` requests
+    within ``batch_window_s`` (greedy drain, single deadline from the first
+    request — the TaskPool discipline) and drives each group through one
+    ``generate_many`` call, so the group's hidden states travel the chain
+    as ONE stacked frame per hop instead of meeting by pool-window luck."""
+
+    def __init__(self, client, request_timeout_s: float = 60.0,
+                 batch_max: int = 0, batch_window_s: float = 0.01):
         self.client = client
         # Share the client's Metrics when it has one: its failover /
         # stale-reply counters then ride the gateway's /metrics for free.
         self.metrics = getattr(client, "metrics", None) or Metrics()
         self._request_timeout_s = request_timeout_s
+        self._batch_max = int(batch_max)
+        self._batch_window_s = batch_window_s
+        self._pending: Optional[queue.Queue] = (
+            queue.Queue() if self._batch_max > 1 else None
+        )
+        self._active: set = set()  # gen_ids admitted to the batched loop
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._threads: Dict[str, threading.Thread] = {}
         self._tlock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._collector: Optional[threading.Thread] = None
         self._ids = 0
 
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
+        if self._pending is not None:
+            self._collector = threading.Thread(
+                target=self._collect, name="client-batcher", daemon=True
+            )
+            self._collector.start()
 
     def submit(self, prompt, options, deadline) -> Handle:
         with self._tlock:
             self._ids += 1
             gid = f"req-{self._ids}"
         h = Handle(gen_id=gid, queue=asyncio.Queue(), stop=threading.Event())
+        if self._pending is not None:
+            with self._tlock:
+                self._active.add(gid)
+            self._pending.put((h, list(prompt), options, deadline))
+            return h
         t = threading.Thread(
             target=self._run, args=(h, list(prompt), options, deadline),
             name=f"client-{gid}", daemon=True,
@@ -211,6 +238,90 @@ class ClientBackend(Backend):
             self._threads[gid] = t
         t.start()
         return h
+
+    def _collect(self) -> None:
+        """Group admitted requests for generate_many. Greedy drain + one
+        window deadline from the first request; each group runs on its own
+        thread so collection never blocks behind a long generation."""
+        while not self._stop_evt.is_set():
+            try:
+                first = self._pending.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            group = [first]
+            deadline = time.monotonic() + self._batch_window_s
+            while len(group) < self._batch_max:
+                try:
+                    group.append(self._pending.get_nowait())
+                except queue.Empty:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        group.append(self._pending.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+            key = f"batch-{group[0][0].gen_id}"
+            t = threading.Thread(target=self._run_group, args=(group, key),
+                                 name=f"client-{key}", daemon=True)
+            with self._tlock:
+                self._threads[key] = t
+            t.start()
+
+    def _run_group(self, group, key: str) -> None:
+        handles = [g[0] for g in group]
+        opts = [g[2] for g in group]
+        deadlines = [g[3] for g in group]
+        n = len(group)
+        expired = [False] * n
+        reasons: Dict[int, str] = {}
+
+        def emit(h: Handle, ev: TokenEvent) -> None:
+            try:
+                self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)
+            except RuntimeError:
+                pass  # loop already closed (server exited mid-generation)
+
+        def stop_check(i: int) -> bool:
+            if handles[i].stop.is_set():
+                return True
+            d = deadlines[i]
+            if d is not None and time.monotonic() >= d:
+                expired[i] = True
+                return True
+            return False
+
+        self.metrics.observe("client_batch_group", n)
+        try:
+            self.client.generate_many(
+                [g[1] for g in group],
+                max_new_tokens=[o.max_new_tokens for o in opts],
+                timeout=self._request_timeout_s,
+                options=opts,
+                on_token=lambda i, t: emit(handles[i], TokenEvent(t, False)),
+                stop_check=stop_check,
+                on_finish=lambda i, r: reasons.__setitem__(i, r),
+            )
+        except Exception as e:  # noqa: BLE001 - every stream must terminate
+            self.metrics.counter("client_generate_errors")
+            for i in range(n):
+                reasons.setdefault(i, f"error: {type(e).__name__}")
+        finally:
+            for i, h in enumerate(handles):
+                reason = reasons.get(i, "length")
+                if expired[i]:
+                    reason = "deadline"
+                    self.metrics.counter("sessions_deadline_expired")
+                elif h.stop.is_set():
+                    reason = "cancelled"
+                elif reason == "stopped":
+                    reason = "cancelled"
+                self.metrics.counter("sessions_finished")
+                emit(h, TokenEvent(-1, True, reason))
+            with self._tlock:
+                for h in handles:
+                    self._active.discard(h.gen_id)
+                self._threads.pop(key, None)
 
     def _run(self, h: Handle, prompt, options, deadline) -> None:
         def emit(ev: TokenEvent) -> None:
@@ -264,9 +375,13 @@ class ClientBackend(Backend):
 
     def active_sessions(self) -> int:
         with self._tlock:
+            if self._pending is not None:
+                return len(self._active)
             return len(self._threads)
 
     def queue_depth(self) -> int:
+        if self._pending is not None:
+            return self._pending.qsize()  # awaiting group formation
         return 0  # admission happens downstream, on the workers
 
     def probe(self) -> bool:
@@ -281,8 +396,11 @@ class ClientBackend(Backend):
             return False
 
     def stop(self, timeout: float = 10.0) -> None:
+        self._stop_evt.set()
         with self._tlock:
             threads = list(self._threads.values())
+        if self._collector is not None:
+            threads.append(self._collector)
         deadline = time.monotonic() + timeout
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))

@@ -1,0 +1,189 @@
+"""TaskPool batching semantics (the continuous-batching scheduling contract).
+
+These tests pin the v2 scheduling rules from the zero-linger rework:
+
+* greedy drain — everything already queued goes out in ONE ``fn`` call,
+* a single deadline-based linger measured from the batch's first item
+  (never one ``window_s`` per empty poll),
+* zero linger once ``max_batch`` is reached,
+* deferred-item fairness — a parked incompatible group runs before items
+  that arrived later, so mixed signatures can't starve.
+
+They are gate-based (the pool's ``fn`` blocks on an Event while the test
+stages the queue), so assertions are about CALL STRUCTURE, not timing; the
+few wall-clock checks use bounds several multiples wide of the window.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from distributed_llm_inference_tpu.distributed import TaskPool
+from distributed_llm_inference_tpu.utils.metrics import Metrics
+
+
+class _Gate:
+    """Blocks one fn call until the test releases it."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+
+def _gated_fn(calls, gates):
+    """fn that records each batch and blocks on the next staged gate."""
+
+    def fn(items):
+        calls.append(list(items))
+        try:
+            gate = gates.get_nowait()
+        except queue.Empty:
+            return [None] * len(items)
+        gate.entered.set()
+        assert gate.release.wait(10), "test forgot to release a gate"
+        return [None] * len(items)
+
+    return fn
+
+
+def test_prequeued_full_queue_one_call_zero_linger():
+    """8 items already queued → exactly one fn call, dispatched without
+    waiting out the window (the already-full queue pays zero added
+    latency; the old per-poll linger would sit in get(timeout) here)."""
+    calls, gates = [], queue.Queue()
+    gate = _Gate()
+    gates.put(gate)
+    window = 1.5
+    with TaskPool(_gated_fn(calls, gates), max_batch=8,
+                  window_s=window) as pool:
+        primer = pool.submit("primer")
+        assert gate.entered.wait(10)  # fn is now parked on the primer
+        futs = [pool.submit(i) for i in range(8)]
+        released = time.monotonic()
+        gate.release.set()
+        for f in futs:
+            f.result(timeout=10)
+        elapsed = time.monotonic() - released
+        primer.result(timeout=10)
+    assert calls[0] == ["primer"]
+    assert calls[1] == list(range(8)), "pre-queued items split across calls"
+    assert len(calls) == 2
+    # Full batch → zero linger: well under one window, let alone the
+    # (max_batch - 1) windows the per-poll pathology would burn.
+    assert elapsed < window, f"full queue lingered {elapsed:.2f}s"
+
+
+def test_linger_is_single_deadline_not_per_poll():
+    """Items trickling in faster than the window must NOT extend the wait:
+    the deadline is fixed at the first item. The old code's get(timeout=
+    window) per item would ride an 0.25s trickle to max_batch (~1.75s);
+    the deadline dispatches at ~window regardless."""
+    calls = []
+
+    def fn(items):
+        calls.append(list(items))
+        return [None] * len(items)
+
+    window = 0.4
+    futs = []
+    done_feeding = threading.Event()
+    with TaskPool(fn, max_batch=8, window_s=window) as pool:
+        def feeder():
+            for i in range(8):
+                futs.append(pool.submit(i))
+                time.sleep(0.25)
+            done_feeding.set()
+
+        t = threading.Thread(target=feeder, daemon=True)
+        start = time.monotonic()
+        t.start()
+        # The first item's batch must close ~one window after it was
+        # submitted — generous bound well under the ~1.75s trickle ride.
+        while not calls:
+            assert time.monotonic() - start < 1.3, (
+                "first batch did not dispatch within the deadline window"
+            )
+            time.sleep(0.01)
+        first_batch_at = time.monotonic() - start
+        assert done_feeding.wait(10)
+        t.join(timeout=10)
+        for f in futs:
+            f.result(timeout=10)
+    assert first_batch_at < 1.3
+    assert sorted(sum(calls, [])) == list(range(8))  # nothing lost
+
+
+def test_single_item_lingers_about_one_window():
+    """A lone item waits for co-batchable company — but only ONE window."""
+    def fn(items):
+        return [None] * len(items)
+
+    window = 0.3
+    with TaskPool(fn, max_batch=8, window_s=window) as pool:
+        start = time.monotonic()
+        pool.submit("solo").result(timeout=10)
+        elapsed = time.monotonic() - start
+    assert elapsed < 4 * window, f"lingered {elapsed:.2f}s for one window"
+
+
+def test_mixed_signatures_defer_fairly_no_starvation():
+    """Incompatible items park in a deferred list that is served BEFORE
+    later arrivals: end/fwd-style mixed traffic can't starve either kind."""
+    calls, gates = [], queue.Queue()
+    g1, g2 = _Gate(), _Gate()
+    gates.put(g1)
+    gates.put(g2)
+    with TaskPool(_gated_fn(calls, gates), max_batch=4, window_s=0.05,
+                  signature=lambda s: s[0]) as pool:
+        futs = [pool.submit("p0")]
+        assert g1.entered.wait(10)
+        # Staged while the pool is busy: two interleaved signature groups.
+        futs += [pool.submit(s) for s in ("a0", "b0", "a1", "b1")]
+        g1.release.set()
+        assert g2.entered.wait(10)  # fn is now in the "a" batch
+        # These arrive AFTER b0/b1 were deferred — fairness says the
+        # deferred b-group dispatches first.
+        futs += [pool.submit(s) for s in ("a2", "a3")]
+        g2.release.set()
+        for f in futs:
+            f.result(timeout=10)
+    assert calls == [["p0"], ["a0", "a1"], ["b0", "b1"], ["a2", "a3"]]
+
+
+def test_occupancy_histogram_recorded():
+    m = Metrics()
+
+    def fn(items):
+        return [None] * len(items)
+
+    with TaskPool(fn, max_batch=4, window_s=0.02, metrics=m) as pool:
+        for f in [pool.submit(i) for i in range(3)]:
+            f.result(timeout=10)
+    snap = m.snapshot()
+    assert snap.get("pool_batch_occupancy_count", 0) >= 1
+    # Per-size counters double as a coarse histogram surface.
+    sizes = [k for k in snap if k.startswith("pool_batches_size_")]
+    assert sizes, snap
+
+
+def test_eager_item_skips_linger_entirely():
+    """An eager item (a source-co-batched stacked frame) is already a
+    batch: with the queue drained it must dispatch at once — a window_s
+    linger here would throttle the lockstep decode loop to ~1/window."""
+    def fn(items):
+        return [None] * len(items)
+
+    with TaskPool(fn, max_batch=8, window_s=30.0) as pool:
+        start = time.monotonic()
+        pool.submit("stacked-frame", eager=True).result(timeout=10)
+        elapsed = time.monotonic() - start
+    assert elapsed < 5.0, f"eager item lingered {elapsed:.2f}s"
+
+
+def test_submit_after_stop_raises():
+    pool = TaskPool(lambda items: [None] * len(items), window_s=0.01)
+    pool.stop()
+    with pytest.raises(RuntimeError):
+        pool.submit(1)

@@ -11,6 +11,10 @@
 # seeded FaultPlan schedules, fast multi-node fault drills) is part of
 # this tier: the '-m not slow' selection below picks it up because the
 # chaos tests are marked 'chaos' but only the long soak cases are 'slow'.
+#
+# tests/test_task_pool.py (the continuous-batching scheduling contract:
+# greedy drain, single-deadline linger, eager stacked frames, deferred
+# fairness) is tier-1 too — gate-based, no device, collected by tests/.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
