@@ -467,12 +467,19 @@ class DistributedClient:
         for p in prompts:
             if not len(p):
                 raise ValueError("empty prompt")
-        max_news = (list(max_new_tokens)
-                    if isinstance(max_new_tokens, (list, tuple))
-                    else [max_new_tokens] * n)
-        opt_list = (list(options) if isinstance(options, (list, tuple))
-                    else [options] * n)
-        seed_list = (list(seeds) if seeds is not None else [0] * n)
+        def per_row(name, val):
+            if not isinstance(val, (list, tuple)):
+                return [val] * n
+            if len(val) != n:
+                raise ValueError(
+                    f"{name} has {len(val)} entries for {n} prompts"
+                )
+            return list(val)
+
+        max_news = per_row("max_new_tokens", max_new_tokens)
+        opt_list = per_row("options", options)
+        seed_list = ([0] * n if seeds is None
+                     else per_row("seeds", list(seeds)))
         rows = []
         for i in range(n):
             opts = opt_list[i] or SamplingOptions()
@@ -715,10 +722,14 @@ class DistributedClient:
 
     def _next_tokens_rows(self, ys, idxs, rows) -> List[int]:
         """One jitted head (+ per-row-keyed sample) call over the stacked
-        rows — ``ys`` are ``[1, S, H]`` slices of equal S. Greedy-only
-        stacks skip the RNG entirely, like the serial path."""
-        x = jnp.asarray(np.concatenate([np.asarray(y) for y in ys], axis=0))
-        idx = jnp.asarray(idxs, jnp.int32)
+        rows — ``ys`` are ``[1, S, H]`` slices whose S may DIFFER (rows of
+        a cohort can end prefill in different buckets), so each row's last
+        valid position is gathered first and the device call always sees a
+        ``[A, 1, H]`` stack (which also keys the jit cache on A alone).
+        Greedy-only stacks skip the RNG entirely, like the serial path."""
+        slices = [np.asarray(y)[:, i : i + 1] for y, i in zip(ys, idxs)]
+        x = jnp.asarray(np.concatenate(slices, axis=0))
+        idx = jnp.zeros(len(rows), jnp.int32)
         if all(r.opts.temperature <= 0.0 for r in rows):
             logits = self._head_rows(self.params, x, idx)
             return [int(t) for t in

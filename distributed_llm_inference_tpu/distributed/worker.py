@@ -212,12 +212,34 @@ class ServingNode:
             # this node already applied is a duplicated delivery — skip it
             # with NO reply (the original's reply already went out; a second
             # reply would itself be a duplicate downstream).
+            # Invariant reply fields computed once per batch, not per item.
+            node = self.node_id
+            shipments = []  # (queue, frame bytes) for ONE pipelined send
             reqs = []    # flattened forward_many items
             frames = []  # (header, rows) — rows: (req_idx | None, gid, nn)
             for _, header, arr in items:
                 gens = header.get("gens")
                 if gens is not None:
-                    metas = list(zip(gens, header.get("num_new") or []))
+                    nns = header.get("num_new")
+                    n_rows = (getattr(arr, "shape", None) or (0,))[0]
+                    if (not isinstance(nns, (list, tuple))
+                            or len(nns) != len(gens)
+                            or n_rows != len(gens)):
+                        # Malformed stacked frame: every row gets an explicit
+                        # error reply — silently dropping rows would leave
+                        # the client blocked for its full hop timeout.
+                        self.metrics.counter("malformed_frames")
+                        hops = header.get("hops") or []
+                        if hops:
+                            for gid in gens:
+                                shipments.append((hops[-1], pack_frame({
+                                    "op": "error", "gen_id": gid,
+                                    "error": "stacked frame: gens/num_new/"
+                                             "payload row counts disagree",
+                                    "code": "schema", "from": node,
+                                })))
+                        continue
+                    metas = list(zip(gens, nns))
                 else:
                     metas = [(header.get("gen_id", ""),
                               header.get("num_new", 0))]
@@ -244,9 +266,6 @@ class ServingNode:
                     g: s for g, s in self._applied_seq.items() if g in live
                 }
             outs = self.backend.forward_many(reqs) if reqs else []
-            # Invariant reply fields computed once per batch, not per item.
-            node = self.node_id
-            shipments = []  # (queue, frame bytes) for ONE pipelined send
             for header, rows in frames:
                 hops = header.get("hops") or []
                 fresh = [(ri, gid, nn) for ri, gid, nn in rows

@@ -221,13 +221,19 @@ class ClientBackend(Backend):
             self._collector.start()
 
     def submit(self, prompt, options, deadline) -> Handle:
+        if self._stop_evt.is_set():
+            # The server drains before backend.stop(), so this only fires
+            # on a race — but a request enqueued after stop would never get
+            # a terminal event.
+            raise RuntimeError("backend is stopping")
         with self._tlock:
             self._ids += 1
             gid = f"req-{self._ids}"
         h = Handle(gen_id=gid, queue=asyncio.Queue(), stop=threading.Event())
         if self._pending is not None:
-            with self._tlock:
-                self._active.add(gid)
+            # Not added to _active yet: a queued request is counted by
+            # queue_depth() alone until the collector claims it (admission
+            # control must not double-count it).
             self._pending.put((h, list(prompt), options, deadline))
             return h
         t = threading.Thread(
@@ -239,26 +245,36 @@ class ClientBackend(Backend):
         t.start()
         return h
 
+    def _claim(self, item):
+        """Move a popped request from the queued count into the active
+        count the moment it leaves ``_pending`` — each request is counted
+        by exactly one of ``queue_depth()`` / ``active_sessions()``."""
+        with self._tlock:
+            self._active.add(item[0].gen_id)
+        return item
+
     def _collect(self) -> None:
         """Group admitted requests for generate_many. Greedy drain + one
         window deadline from the first request; each group runs on its own
         thread so collection never blocks behind a long generation."""
         while not self._stop_evt.is_set():
             try:
-                first = self._pending.get(timeout=0.1)
+                first = self._claim(self._pending.get(timeout=0.1))
             except queue.Empty:
                 continue
             group = [first]
             deadline = time.monotonic() + self._batch_window_s
             while len(group) < self._batch_max:
                 try:
-                    group.append(self._pending.get_nowait())
+                    group.append(self._claim(self._pending.get_nowait()))
                 except queue.Empty:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     try:
-                        group.append(self._pending.get(timeout=remaining))
+                        group.append(self._claim(
+                            self._pending.get(timeout=remaining)
+                        ))
                     except queue.Empty:
                         break
             key = f"batch-{group[0][0].gen_id}"
@@ -397,10 +413,32 @@ class ClientBackend(Backend):
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop_evt.set()
+        deadline = time.monotonic() + timeout
+        if self._collector is not None:
+            # Join the collector FIRST so the drain below has no concurrent
+            # consumer racing it for queued requests.
+            self._collector.join(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
+        if self._pending is not None:
+            # Requests admitted but never grouped still owe their streams a
+            # terminal event — without one the gateway handler blocks for
+            # the full request timeout.
+            while True:
+                try:
+                    h = self._pending.get_nowait()[0]
+                except queue.Empty:
+                    break
+                self.metrics.counter("sessions_finished")
+                if self._loop is not None:
+                    try:
+                        self._loop.call_soon_threadsafe(
+                            h.queue.put_nowait,
+                            TokenEvent(-1, True, "cancelled"),
+                        )
+                    except RuntimeError:
+                        pass  # loop already closed
         with self._tlock:
             threads = list(self._threads.values())
-        if self._collector is not None:
-            threads.append(self._collector)
-        deadline = time.monotonic() + timeout
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))

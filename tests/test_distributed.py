@@ -898,6 +898,64 @@ def test_generate_many_sampling_matches_serial(cluster, params):
     assert many == serial
 
 
+def test_generate_many_mixed_prefill_buckets(cluster, params):
+    """A cohort whose prompts end prefill in DIFFERENT buckets (different
+    padded S) still samples its first tokens correctly — the first-token
+    path gathers each row's last valid position before stacking instead
+    of concatenating ragged ``[1, bucket, H]`` slices."""
+    relay, *_ = cluster
+    # Buckets (4, 16): lengths 2 and 3 pad to 4, length 6 pads to 16.
+    prompts = [[5, 11, 42], [7, 3, 9, 1, 30, 2], [8, 4]]
+    with DistributedClient(
+        relay.port, CFG, params, prefill_buckets=(4, 16), dtype=jnp.float32
+    ) as client:
+        serial = [client.generate(p, max_new_tokens=5) for p in prompts]
+        many = client.generate_many(prompts, max_new_tokens=5)
+    assert many == serial
+
+
+def test_generate_many_rejects_mismatched_row_args(cluster, params):
+    """Per-row argument lists shorter/longer than the cohort fail up front
+    with a clear ValueError, not a mid-flight IndexError."""
+    relay, *_ = cluster
+    prompts = [[5, 11], [7, 3]]
+    with DistributedClient(
+        relay.port, CFG, params, prefill_buckets=(16,), dtype=jnp.float32
+    ) as client:
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            client.generate_many(prompts, max_new_tokens=[3])
+        with pytest.raises(ValueError, match="options"):
+            client.generate_many(prompts, max_new_tokens=3,
+                                 options=[None, None, None])
+        with pytest.raises(ValueError, match="seeds"):
+            client.generate_many(prompts, max_new_tokens=3, seeds=[1])
+
+
+def test_worker_rejects_malformed_stacked_frame(cluster, params):
+    """A stacked frame whose gens/num_new/payload row counts disagree gets
+    an explicit per-row error reply — dropped rows must never leave the
+    client waiting out its full hop timeout."""
+    from distributed_llm_inference_tpu.distributed.messages import (
+        pack_frame, unpack_frame,
+    )
+    from distributed_llm_inference_tpu.distributed.relay import RelayClient
+
+    relay, _, n1, _ = cluster
+    with RelayClient(port=relay.port) as c:
+        header = {"op": "forward", "gens": ["ma", "mb"], "num_new": [1],
+                  "hops": ["reply.mal"], "new": True, "seq": 0}
+        x = np.zeros((2, 1, CFG.hidden_size), np.float32)
+        c.put(n1.queue, pack_frame(header, x))
+        seen = {}
+        for _ in range(2):
+            reply, _ = unpack_frame(c.get("reply.mal", timeout=10))
+            assert reply["op"] == "error"
+            assert reply["code"] == "schema"
+            seen[reply["gen_id"]] = reply["error"]
+    assert set(seen) == {"ma", "mb"}
+    assert n1.metrics.snapshot().get("malformed_frames") == 1
+
+
 def test_client_connection_pool_reuses_relay(cluster, params):
     """Satellite: one dialed connection serves many generations — the
     pool returns clean connections for reuse across calls."""

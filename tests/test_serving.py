@@ -250,3 +250,32 @@ def test_bad_requests_get_400():
         conn, resp = _get(server.port, "/nope")
         assert resp.status == 404
         conn.close()
+
+
+def test_client_backend_stop_drains_pending_and_rejects_submit():
+    """Batched ClientBackend lifecycle: requests admitted but never grouped
+    get a terminal 'cancelled' event on stop (their streams must not hang
+    for the full request timeout), submit after stop is rejected, and a
+    queued request is counted by queue_depth alone — never double-counted
+    by active_sessions."""
+    import asyncio
+
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+    from distributed_llm_inference_tpu.serving.backends import ClientBackend
+
+    backend = ClientBackend(client=object(), batch_max=4)
+    loop = asyncio.new_event_loop()
+    try:
+        backend._loop = loop  # no collector running: requests stay queued
+        h = backend.submit([1, 2, 3], SamplingOptions(), None)
+        assert backend.queue_depth() == 1
+        assert backend.active_sessions() == 0  # queued, not yet grouped
+        backend.stop(timeout=1.0)
+        loop.run_until_complete(asyncio.sleep(0.01))  # run drain callbacks
+        ev = h.queue.get_nowait()
+        assert ev.finished and ev.finish_reason == "cancelled"
+        assert backend.queue_depth() == 0
+        with pytest.raises(RuntimeError, match="stopping"):
+            backend.submit([1], SamplingOptions(), None)
+    finally:
+        loop.close()
