@@ -651,27 +651,72 @@ def _engine_decode_bench(cfg, params, batch, prompt_len, ticks=4,
         ttfts.append((time.perf_counter() - t1) * 1e3)
         assert any(fin for _, _t, fin in ev)
         eng.collect_finished()
-    # Concurrent-admission burst (r4 batched multi-row prefill): k sessions
-    # submitted together must admit in ONE bucketed dispatch, costing far
-    # less than k sequential single-row prefills. Only the engine phase
-    # reports it — other callers skip the extra tunneled prefills.
-    burst_ms, k_burst = None, 0
+    # Concurrent-admission burst measured against a LIVE decode (r5 ask:
+    # the stall matters only when it preempts serving): batch-k resident
+    # sessions decode continuously; k sessions then land while a pipelined
+    # tick is in flight. We time the admitting step() and compare resident
+    # token delivery in a 2-step window starting at the burst against the
+    # same window in steady state — with overlapped admission the prefill
+    # dispatch rides the in-flight tick and the ratio stays ~1.0; the old
+    # synchronous path blocked the window on k tunneled prefill fetches.
+    # min/median over >= 5 reps (one noisy rep must not swing the record);
+    # residents are resubmitted fresh each rep so their context growth
+    # stays inside max_seq (sized for warm+ticks only — growing it would
+    # cross the remote compiler's ~B x T cliff at the b112 headline).
+    burst = None
     if measure_burst:
         k_burst = min(4, batch)
-        bursts = []
-        for _ in range(3):
-            for _ in range(k_burst):
-                eng.submit([2] * prompt_len,
-                           SamplingOptions(max_new_tokens=1, eos_token_id=-1))
+        n_res = max(1, batch - k_burst)
+        long_opts = SamplingOptions(max_new_tokens=1_000_000, eos_token_id=-1)
+        reps, admit_ms, burst_tps, steady_tps = 5, [], [], []
+        for _ in range(reps):
+            res = [eng.submit([3] * prompt_len, long_opts)
+                   for _ in range(n_res)]
+            eng.step()  # admit residents (no tick in flight yet)
+            eng.step()  # first pipelined tick now in flight
+            resset = set(res)
+            t0 = time.perf_counter()
+            n0 = 0
+            for _ in range(2):
+                for g, tok, _f in eng.step():
+                    if tok != -1 and g in resset:
+                        n0 += 1
+            steady_tps.append(n0 / (time.perf_counter() - t0))
+            bs = [eng.submit([2] * prompt_len, long_opts)
+                  for _ in range(k_burst)]
             t1 = time.perf_counter()
-            eng.step()
-            bursts.append((time.perf_counter() - t1) * 1e3)
-            eng.step()
+            n1 = 0
+            for g, tok, _f in eng.step():  # the admitting step
+                if tok != -1 and g in resset:
+                    n1 += 1
+            admit_ms.append((time.perf_counter() - t1) * 1e3)
+            for g, tok, _f in eng.step():
+                if tok != -1 and g in resset:
+                    n1 += 1
+            burst_tps.append(n1 / (time.perf_counter() - t1))
+            for g in res + bs:
+                eng.cancel(g)
+            while eng.has_work():
+                eng.step()
             eng.collect_finished()
-        burst_ms = float(np.percentile(bursts, 50))
+        steady = float(np.percentile(steady_tps, 50))
+        during = float(np.percentile(burst_tps, 50))
+        burst = {
+            "admit_burst_ms": round(float(np.min(admit_ms)), 2),
+            "admit_burst_ms_p50": round(float(np.percentile(admit_ms, 50)),
+                                        2),
+            "burst_sessions": k_burst,
+            "resident_sessions": n_res,
+            "tok_s_steady": round(steady, 2),
+            "tok_s_during_burst": round(during, 2),
+            "burst_vs_steady_pct": round(100 * during / steady, 1)
+            if steady else None,
+            "reps": reps,
+            "overlap_admission": bool(eng.ecfg.overlap_admission),
+        }
     return (
         delivered / dt, float(np.percentile(ttfts, 50)), eng.decode_steps,
-        burst_ms, k_burst,
+        burst,
     )
 
 
@@ -1029,7 +1074,7 @@ def _engine_phase() -> dict:
     out = None
     for batch in ((112, 96, 72, 64) if on_tpu else (8,)):
         try:
-            tok_s, ttft, k, burst_ms, k_burst = _engine_decode_bench(
+            tok_s, ttft, k, burst = _engine_decode_bench(
                 cfg, params, batch, prompt_len=128 if on_tpu else 16,
                 measure_burst=True,
             )
@@ -1040,8 +1085,8 @@ def _engine_phase() -> dict:
             "tok_s": round(tok_s, 2), "batch": batch, "weights": "int8",
             "prompt_len": 128 if on_tpu else 16,
             "ttft_ms": round(ttft, 2), "decode_steps": k,
-            "admit_burst_ms": round(burst_ms, 2),
-            "admit_burst_sessions": k_burst,
+            "admit_burst_ms": burst["admit_burst_ms"] if burst else None,
+            "admit_burst": burst,
             "scope": "InferenceEngine.step() end to end",
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0].device_kind),
@@ -1116,20 +1161,35 @@ def _prefill_phase() -> dict:
         jax.block_until_ready(
             prefill(params, jnp.zeros((1, S), jnp.int32), cache)
         )
-        dev = _device_time_ms_per_call(
-            lambda i: prefill(
-                params, jnp.full((1, S), (i % 17) + 1, jnp.int32), cache
-            ),
-            reps=3,
-        )
-        if dev:
-            rate = model_tflops(S) / (dev / 1e3)
+        # One trace per rep (>= 5) so we can report min AND median — the
+        # old single-trace mean let one noisy run swing the canonical
+        # record (VERDICT weak #2). Inputs vary per rep: the axon tunnel
+        # memoizes identical input buffers.
+        devs = [
+            d for r in range(5)
+            if (d := _device_time_ms_per_call(
+                lambda i, r=r: prefill(
+                    params,
+                    jnp.full((1, S), ((5 * r + i) % 17) + 1, jnp.int32),
+                    cache,
+                ),
+                reps=1,
+            )) is not None
+        ]
+        if devs:
+            dmin, dp50 = min(devs), float(np.percentile(devs, 50))
             out[f"prompt_{S}"] = {
-                "device_ms": dev, "tflop_s": round(rate, 1),
-                "pct_of_nominal_197": round(100 * rate / 197, 1),
+                "reps": len(devs),
+                "device_ms_min": round(dmin, 2),
+                "device_ms_p50": round(dp50, 2),
+                "tflop_s_best": round(model_tflops(S) / (dmin / 1e3), 1),
+                "tflop_s_p50": round(model_tflops(S) / (dp50 / 1e3), 1),
+                "pct_of_nominal_197": round(
+                    100 * model_tflops(S) / (dp50 / 1e3) / 197, 1
+                ),
             }
         else:
-            out[f"prompt_{S}"] = {"device_ms": None}
+            out[f"prompt_{S}"] = {"device_ms_min": None}
     return out
 
 
@@ -1557,6 +1617,37 @@ def main():
         "device": best.get("device", "unknown"),
         "model": best.get("model", "unknown"),
     }))
+
+    # The LAST stdout line is a compact per-phase headline summary: the
+    # driver's tail capture truncates the full record above (hundreds of
+    # keys), which parsed as null. Keep this to one short JSON line.
+    summary = {
+        "tok_s": best["tok_s"],
+        "vs_baseline": round(best["tok_s"] / NORTH_STAR_TOK_S_CHIP, 4),
+        "batch": best["batch"],
+        "backend": best.get("backend", "unknown"),
+    }
+    for name, r in results.items():
+        if not isinstance(r, dict):
+            continue
+        if r.get("error"):
+            summary[name] = "error"
+        elif r.get("tok_s") is not None:
+            summary[name] = r["tok_s"]
+    if eng.get("admit_burst_ms") is not None:
+        summary["admit_burst_ms"] = eng["admit_burst_ms"]
+        ab = eng.get("admit_burst") or {}
+        if ab.get("burst_vs_steady_pct") is not None:
+            summary["burst_vs_steady_pct"] = ab["burst_vs_steady_pct"]
+    pf = results.get("prefill", {})
+    pf_ms = {
+        k.replace("prompt_", "p"): v["device_ms_p50"]
+        for k, v in pf.items()
+        if isinstance(v, dict) and v.get("device_ms_p50") is not None
+    }
+    if pf_ms:
+        summary["prefill_device_ms_p50"] = pf_ms
+    print(json.dumps(summary, separators=(",", ":")))
 
 
 if __name__ == "__main__":

@@ -213,6 +213,24 @@ class EngineConfig:
     # for a tick arrive one step() later. Budgets are computed conservatively
     # against the in-flight tick so no rollback is ever needed.
     pipelined_ticks: bool = True
+    # Overlapped (stall-free) admission, pipelined engines only: when a
+    # decode tick is in flight, admission prefills DISPATCH immediately
+    # (JAX dispatch is async — the prefill program executes on-device
+    # right behind the running tick) but the host defers the sampled
+    # first-token fetch to the next tick boundary, where it rides the
+    # tick-resolve ``device_get``. The tick boundary applies only slot /
+    # page bookkeeping — no tick ever blocks on prefill completion. The
+    # device programs and RNG sequence are IDENTICAL to the synchronous
+    # path (only the fetch timing moves), so token streams are byte-exact
+    # with the flag on or off. Opt-out flag; ignored on engines that are
+    # not pipelined (draft models, sink bf16, K=1) or that serve sharded
+    # (mesh engines keep the synchronous single-writer flow).
+    overlap_admission: bool = True
+    # Back-pressure for overlapped admission: at most this many deferred
+    # prefill programs may be in flight at once; an admission flood past
+    # the cap spills to the existing synchronous path (bounded device
+    # queue instead of unbounded queued prefill work).
+    overlap_admission_max_inflight: int = 4
     # speculative decoding
     speculative_k: int = 0  # 0 = disabled
     # Adaptive speculation (pipelined spec engines): when the MEASURED

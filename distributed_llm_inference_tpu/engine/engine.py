@@ -527,6 +527,20 @@ class InferenceEngine:
         self._pending = None
         self._carry = None
         self._carry_ok = np.zeros(self.batch, np.bool_)
+        # -- overlapped (stall-free) admission ---------------------------------
+        # With a pipelined tick in flight, admission prefills DISPATCH as
+        # usual (the program queues right behind the running tick — JAX
+        # dispatch is async) but the host defers the blocking first-token
+        # fetch: each record below holds (sessions, device tokens, skips)
+        # until the next tick boundary, where the fetch rides the tick
+        # resolve's device_get. The sampled tokens scatter into the carry
+        # so the very next tick consumes them with NO host round trip, and
+        # ``_admit_pend`` charges one conservative in-flight token per row
+        # (mirroring the pipelined budget discipline). Device programs and
+        # RNG order are identical to the synchronous path — token streams
+        # are byte-exact with ``overlap_admission`` on or off.
+        self._inflight_admits: List[Tuple[List[Session], jax.Array, List[int]]] = []
+        self._admit_pend = np.zeros(self.batch, np.int32)
         # Any tail-capable cache pipelines (dense kinds and the paged pools'
         # fused windows); the sink ring (no tail) and draft-model engines
         # keep the synchronous flow.
@@ -543,8 +557,16 @@ class InferenceEngine:
         def _carry_merge(em_last, old, act):
             return jnp.where(act[:, None], em_last[:, None], old)
 
+        def _carry_scatter(carry, toks, rows):
+            # Overlapped admission: deferred first tokens land in the
+            # pipelined carry at their rows. Padding entries use an
+            # out-of-range row — the scatter drops them (same contract as
+            # merge_rows).
+            return carry.at[rows, 0].set(toks)
+
         self._carry_combine = self._with_mesh(jax.jit(_carry_combine))
         self._carry_merge = self._with_mesh(jax.jit(_carry_merge))
+        self._carry_scatter = jax.jit(_carry_scatter)
 
         # -- ring (sequence-parallel) prefill (SURVEY §5.7) -------------------
         self._ring_prefill = None
@@ -865,6 +887,10 @@ class InferenceEngine:
                 "win_ticks": 0, "spec_rate": None, "plain_rate": None,
                 "cooldown": 0, "stat0": dict(self.spec_stats),
                 "tpr_ema": None,
+                # Resident-set signature at the current window's start:
+                # composition churn mid-window re-baselines the window
+                # (ADVICE r5 — mixed-composition rates bias the A/B).
+                "comp": None,
             }
 
     def _sink_cap(self) -> int:
@@ -1121,6 +1147,7 @@ class InferenceEngine:
                 bool(self.waiting)
                 or any(s is not None for s in self.slots)
                 or self._pending is not None
+                or bool(self._inflight_admits)
                 or getattr(self, "_spec_pending", None) is not None
             )
 
@@ -1370,6 +1397,56 @@ class InferenceEngine:
         for s, skip in singles:
             self._run_prefill(s, produced, skip=skip)
 
+    def _overlap_ok(self) -> bool:
+        """Overlap THIS admission with the in-flight tick? Requires the
+        pipelined carry machinery (so the next tick consumes the deferred
+        first token without a host fetch), a tick actually in flight
+        (otherwise the synchronous path is already stall-free — there is
+        nothing to overlap), a single-device engine (mesh engines keep the
+        synchronous flow: ring/sp prefill is a different, collective-
+        bearing program, and the same GSPMD scatter constraint that turns
+        batched admission off applies to the deferred carry scatter), and
+        head-room under the in-flight cap (back-pressure: an admission
+        flood spills to the synchronous path instead of queueing unbounded
+        prefill work on the device)."""
+        if not (
+            self.ecfg.overlap_admission
+            and self._pipelined
+            and self._pending is not None
+            and self.mesh is None
+        ):
+            return False
+        if (
+            len(self._inflight_admits)
+            >= max(1, self.ecfg.overlap_admission_max_inflight)
+        ):
+            self.metrics.counter("admit_overlap_spill")
+            return False
+        return True
+
+    def _defer_admit(self, group, toks_dev, rows, skips) -> None:
+        """Record an overlapped admission: the prefill (and merge) is
+        already dispatched; the sampled first tokens stay device-resident.
+        They scatter into the pipelined carry so the next tick consumes
+        them with no host round trip; ``_admit_pend`` charges one
+        conservative in-flight token per row. ``_resolve_pending`` fetches
+        and delivers at the next tick boundary."""
+        toks_dev = jnp.reshape(toks_dev, (-1,))
+        self._carry = self._carry_scatter(
+            self._carry, toks_dev, jnp.asarray(rows, jnp.int32)
+        )
+        now = time.monotonic()
+        for s in group:
+            s.prefill_inflight = True
+            s.prefill_dispatch_t = now
+            self._carry_ok[s.slot] = True
+            self._admit_pend[s.slot] = 1
+        self._inflight_admits.append((list(group), toks_dev, list(skips)))
+        self.metrics.counter("admit_overlap_sessions", len(group))
+        self.metrics.gauge(
+            "admit_overlap_inflight", float(len(self._inflight_admits))
+        )
+
     def _prefill_group(self, group, bucket, produced) -> None:
         """One batched prefill dispatch for <= 8 same-bucket sessions.
         Rows pad to a power of two (duplicating row 0 with ``n_valid = 0``
@@ -1417,8 +1494,17 @@ class InferenceEngine:
                     jnp.asarray(rows), jnp.asarray(n_valid),
                     self._next_key(), sp,
                 )
+            if self._overlap_ok():
+                # Everything above was dispatch-only; defer the blocking
+                # token fetch to the next tick boundary (it rides the tick
+                # resolve's device_get) so this tick never stalls on
+                # prefill completion.
+                self.metrics.counter("batched_prefills", k)
+                self._defer_admit(group, toks, rows, [0] * k)
+                return
             toks = np.asarray(jax.device_get(toks))
         self.metrics.counter("batched_prefills", k)
+        self.metrics.counter("admit_sync_sessions", k)
         for i, s in enumerate(group):
             self._finish_prefill(
                 s, int(toks[i]), np.asarray(s.prompt, np.int32), produced, 0
@@ -1501,6 +1587,9 @@ class InferenceEngine:
                     jnp.int32(len(prompt)), self._next_key(), sp,
                 )
             self.metrics.counter("ring_prefills")
+            # Ring/sp prefill stays synchronous by design: it only exists
+            # on mesh engines (see _overlap_ok's rationale).
+            self.metrics.counter("admit_sync_sessions")
             self._finish_prefill(s, int(token), prompt, produced, skip)
             return
         offset = skip
@@ -1523,6 +1612,13 @@ class InferenceEngine:
                 self.params, jnp.asarray(padded), self.cache, s.slot,
                 jnp.int32(len(rest)), self._next_key(), sp,
             )
+        if self._overlap_ok():
+            # Single-row admissions defer the token fetch exactly like the
+            # batched path — the chunked prefill above was dispatch-only.
+            self._defer_admit([s], token, np.asarray([s.slot], np.int32),
+                              [skip])
+            return
+        self.metrics.counter("admit_sync_sessions")
         self._finish_prefill(s, int(token), prompt, produced, skip)
 
     def _finish_prefill(self, s, token, prompt, produced, skip):
@@ -1624,6 +1720,20 @@ class InferenceEngine:
             return
         now = time.monotonic()
         tokens = self._decode_tokens_total()
+        comp = tuple(self.slots)
+        if comp != c.get("comp"):
+            # Batch composition changed mid-window (admission / finish /
+            # cancel): the window's tokens/s mixes two resident sets and
+            # would bias the spec-vs-plain comparison — session churn could
+            # latch the wrong mode until the next probe period. Re-baseline
+            # the window instead of folding it into the EMA (mirrors the
+            # full-disengagement reset above).
+            c["comp"] = comp
+            if c["win_t0"] is not None:
+                self.metrics.counter("spec_adapt_window_resets")
+            c.update(win_t0=now, win_tok0=tokens, win_ticks=0,
+                     stat0=dict(self.spec_stats))
+            return
         if c["win_t0"] is None or c.get("skip", 0) > 0:
             # (Re-)baseline: after engagement gaps and for the first tick
             # after a mode transition — that tick absorbs the new path's
@@ -1720,6 +1830,12 @@ class InferenceEngine:
             )
         else:
             pend_b = np.zeros((self.batch,), np.int32)
+        if self._admit_pend.any():
+            # Overlapped admissions dispatched last tick: each row's sampled
+            # first token is still in flight (device-resident; this tick
+            # consumes it via the carry) — charge it like in-flight tick
+            # budget so max_new_tokens and capacity stay exact.
+            pend_b = pend_b + self._admit_pend
         fresh = np.zeros((self.batch, 1), np.int32)
         use_carry = np.zeros((self.batch,), np.bool_)
         opts: List[SamplingOptions] = [SamplingOptions()] * self.batch
@@ -1799,12 +1915,44 @@ class InferenceEngine:
         """Fetch and deliver the PREVIOUS tick's tokens (the copy overlaps
         the tick just dispatched). Rows that stopped mid-tick but keep
         serving (budget exhaustion) get their device carry invalidated —
-        the next dispatch feeds them the host-known last token instead."""
+        the next dispatch feeds them the host-known last token instead.
+
+        Overlapped admissions dispatched last step resolve here too: their
+        deferred first tokens ride the SAME ``device_get`` (one tunnel
+        round trip covers the tick and every pending admission — a second
+        fetch would cost ~180 ms on this platform regardless of size),
+        then the usual prefill bookkeeping runs. Sessions cancelled while
+        their prefill was in flight drop the token (``_deliver``'s guard);
+        the admission reap frees their slot and pages right after."""
+        admits, self._inflight_admits = self._inflight_admits, []
+        if prev is None and not admits:
+            return
+        fetch = [toks for _, toks, _ in admits]
+        if prev is not None:
+            fetch.append(prev[0])
+        with self.metrics.timer("decode_resolve"):
+            got = jax.device_get(fetch)
+        if admits:
+            self._admit_pend[:] = 0
+            self.metrics.gauge("admit_overlap_inflight", 0.0)
+            now = time.monotonic()
+            for (group, _, skips), toks in zip(admits, got):
+                toks = np.asarray(toks).reshape(-1)
+                for i, s in enumerate(group):
+                    s.prefill_inflight = False
+                    if s.prefill_dispatch_t is not None:
+                        self.metrics.observe(
+                            "admit_to_merge", now - s.prefill_dispatch_t
+                        )
+                        s.prefill_dispatch_t = None
+                    self._finish_prefill(
+                        s, int(toks[i]), np.asarray(s.prompt, np.int32),
+                        produced, skips[i],
+                    )
         if prev is None:
             return
         emitted_dev, budget, active, gids = prev
-        with self.metrics.timer("decode_resolve"):
-            emitted = np.asarray(jax.device_get(emitted_dev))
+        emitted = np.asarray(got[-1])
         delivered_total = 0
         for slot, gid in enumerate(gids):
             if gid is None or not active[slot]:

@@ -52,6 +52,14 @@ class Session:
     # Memoized prompt-prefix chain keys (prefix caching; computed once even
     # when pool pressure re-runs admission over many ticks).
     prefix_keys: Optional[List[bytes]] = None
+    # True while an overlapped-admission prefill is in flight on device
+    # (dispatched, first token not yet fetched — engine._inflight_admits).
+    # Cancels/deadlines that land in this window drop the fetched result;
+    # the scheduler's normal reap frees the slot and pages.
+    prefill_inflight: bool = False
+    # When the prefill was dispatched (overlap path) — the admit-to-merge
+    # latency observed at resolve time is ``resolve_t - prefill_dispatch_t``.
+    prefill_dispatch_t: Optional[float] = None
     # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

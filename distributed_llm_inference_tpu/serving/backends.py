@@ -140,6 +140,13 @@ class EngineBackend(Backend):
                 if finished:
                     s = self.engine.sessions.get(gid)
                     reason = s.finish_reason if s is not None else "cancelled"
+                    if s is not None and s.ttft is not None:
+                        # Engine-side TTFT (submit → first token recorded by
+                        # the scheduler): isolates admission stall — the
+                        # quantity overlapped admission shrinks — from the
+                        # gateway's wall-clock ``ttft`` (which adds HTTP
+                        # queueing/fan-out time). Both ride /metrics.
+                        self.metrics.observe("engine_ttft", s.ttft)
                 ev = TokenEvent(token, finished, reason)
                 try:
                     self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)

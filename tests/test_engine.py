@@ -806,3 +806,160 @@ def test_engine_ring_prefill_paged_matches_solo(kv_quant):
     )
     assert eng.generate(long_prompts, opts) == plain
     assert eng.metrics.snapshot().get("ring_prefills") == 2
+
+
+# -- overlapped (stall-free) admission ----------------------------------------
+
+
+def _overlap_engine(kind, overlap, rng_seed=7, batch=3, **ekw):
+    cache_kw = dict(kind="dense")
+    if kind == "paged":
+        # kv_quant="int8" so the paged pool is tail-capable on CPU (the
+        # bf16 pool needs the Pallas kernel to pipeline).
+        cache_kw = dict(kind="paged", kv_quant="int8", page_size=8,
+                        num_pages=64, max_pages_per_session=8)
+    ekw.setdefault("max_batch_size", batch)
+    ekw.setdefault("prefill_buckets", (8, 16))
+    ekw.setdefault("max_seq_len", 64)
+    # Short fused ticks (4 decode steps) so a session's budget spans
+    # several ticks: admissions then land while a tick is genuinely in
+    # flight, exercising the deferred-fetch overlap path. With the
+    # default 16-step tick, these tiny max_new budgets fit in ONE tick
+    # and every admission would (correctly) fall back to sync.
+    ekw.setdefault("decode_steps", 4)
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(dtype="float32", overlap_admission=overlap, **ekw),
+        CacheConfig(**cache_kw), rng=jax.random.PRNGKey(rng_seed),
+    )
+
+
+def _churn_run(kind, overlap, ps, opts, rng_seed=7):
+    """Run ``ps`` to completion with staggered admissions: two residents
+    first, then the rest submitted once a pipelined tick is in flight, so
+    later admissions land mid-tick and (overlap on) take the deferred-
+    fetch path. A single up-front generate() would admit lockstep cohorts
+    whose members all finish exactly when the dispatch runs dry — pending
+    would be None at every churn admission and overlap would never
+    engage."""
+    eng = _overlap_engine(kind, overlap, rng_seed=rng_seed)
+    gids = [eng.submit(ps[0], opts), eng.submit(ps[1], opts)]
+    eng.step()  # admit the residents synchronously (no tick in flight)
+    eng.step()  # first pipelined tick now in flight
+    gids += [eng.submit(p, opts) for p in ps[2:]]
+    while eng.has_work():
+        eng.step()
+    return [eng.sessions[g].generated for g in gids], eng.metrics.snapshot()
+
+
+@pytest.mark.parametrize("kind", ["dense", "paged"])
+def test_overlap_admission_parity_greedy(kind):
+    """Byte-exact token parity with overlap_admission on vs off under
+    churn (7 prompts over 3 slots: later admissions land while a
+    pipelined tick is in flight and take the deferred-fetch path)."""
+    ps = prompts(7, lo=3, hi=14, seed=71)
+    opts = SamplingOptions(max_new_tokens=10)
+    on, snap = _churn_run(kind, True, ps, opts)
+    off, snap_off = _churn_run(kind, False, ps, opts)
+    assert on == off
+    # The overlap engine actually exercised the deferred path (without
+    # this the parity assert could pass vacuously).
+    assert snap.get("admit_overlap_sessions", 0) > 0
+    assert snap_off.get("admit_overlap_sessions", 0) == 0
+
+
+@pytest.mark.parametrize("kind", ["dense", "paged"])
+def test_overlap_admission_parity_sampled(kind):
+    """Sampled streams (temperature/top_p) are byte-exact too: the overlap
+    path defers only the token FETCH — device programs and RNG-key order
+    are identical, so sampling draws the same values."""
+    ps = prompts(7, lo=3, hi=14, seed=72)
+    opts = SamplingOptions(max_new_tokens=10, temperature=1.0, top_p=0.9)
+    on, snap = _churn_run(kind, True, ps, opts, rng_seed=11)
+    off, _ = _churn_run(kind, False, ps, opts, rng_seed=11)
+    assert on == off
+    assert snap.get("admit_overlap_sessions", 0) > 0
+
+
+def test_cancel_during_inflight_prefill():
+    """A cancel that lands while a session's overlapped prefill is in
+    flight drops the deferred first token (no tokens ever delivered) and
+    frees the slot and pages at the next tick boundary."""
+    eng = _overlap_engine("paged", True, batch=2)
+    free0 = eng.allocator.free_count
+    a = eng.submit(prompts(1, seed=80)[0], SamplingOptions(max_new_tokens=64))
+    eng.step()  # admit a synchronously (no tick in flight yet)
+    eng.step()  # dispatch the first pipelined tick
+    b = eng.submit(prompts(1, seed=81)[0], SamplingOptions(max_new_tokens=64))
+    eng.step()  # admit b OVERLAPPED behind the in-flight tick
+    sb = eng.sessions[b]
+    assert sb.prefill_inflight and sb.generated == []
+    assert eng.metrics.get_gauge("admit_overlap_inflight") == 1
+    eng.cancel(b)
+    eng.step()  # resolve drops b's token; the reap frees slot + pages
+    assert sb.finish_reason == "cancelled"
+    assert sb.generated == [] and sb.slot is None and sb.pages == []
+    assert not sb.prefill_inflight
+    assert eng.metrics.get_gauge("admit_overlap_inflight") == 0
+    eng.cancel(a)
+    while eng.has_work():
+        eng.step()
+    assert eng.allocator.free_count == free0  # every page reclaimed
+
+
+def test_deadline_during_inflight_prefill():
+    """A deadline expiring while the prefill is in flight reaps the
+    session at the next tick boundary (finish_reason "deadline"), exactly
+    like the synchronous path — at most the deferred first token is
+    delivered before the terminal event."""
+    import time as _time
+
+    eng = _overlap_engine("paged", True, batch=2)
+    free0 = eng.allocator.free_count
+    a = eng.submit(prompts(1, seed=82)[0], SamplingOptions(max_new_tokens=64))
+    eng.step()
+    eng.step()
+    b = eng.submit(prompts(1, seed=83)[0],
+                   SamplingOptions(max_new_tokens=64),
+                   deadline=_time.monotonic() + 60.0)
+    eng.step()  # overlapped admission
+    sb = eng.sessions[b]
+    assert sb.prefill_inflight
+    sb.deadline = _time.monotonic() - 0.001  # expire while in flight
+    eng.step()
+    assert sb.finish_reason == "deadline"
+    assert len(sb.generated) <= 1 and sb.slot is None and sb.pages == []
+    eng.cancel(a)
+    while eng.has_work():
+        eng.step()
+    assert eng.allocator.free_count == free0
+
+
+def test_overlap_admission_flood_backpressure():
+    """An admission flood past overlap_admission_max_inflight spills to
+    the synchronous path (bounded in-flight device work) and still
+    produces byte-exact streams."""
+    ps = prompts(9, lo=3, hi=15, seed=90)
+    opts = SamplingOptions(max_new_tokens=7)
+
+    def run(overlap):
+        eng = _overlap_engine("dense", overlap, batch=8,
+                              overlap_admission_max_inflight=1)
+        # One resident session keeps a tick in flight, then the flood of 8
+        # arrives in a single admission pass spanning both prompt buckets.
+        first = eng.submit(ps[0], opts)
+        eng.step()
+        eng.step()
+        rest = [eng.submit(p, opts) for p in ps[1:]]
+        while eng.has_work():
+            eng.step()
+        outs = [eng.sessions[g].generated for g in [first] + rest]
+        return outs, eng.metrics.snapshot()
+
+    on, snap = run(True)
+    off, _ = run(False)
+    assert on == off
+    assert snap.get("admit_overlap_sessions", 0) > 0  # some overlapped
+    assert snap.get("admit_overlap_spill", 0) > 0     # cap forced a spill
+    assert snap.get("admit_sync_sessions", 0) > 0     # ...to the sync path
+    assert snap.get("admit_to_merge_count", 0) >= 1   # latency observed

@@ -15,6 +15,13 @@
 # tests/test_task_pool.py (the continuous-batching scheduling contract:
 # greedy drain, single-deadline linger, eager stacked frames, deferred
 # fairness) is tier-1 too — gate-based, no device, collected by tests/.
+#
+# The admission-overlap contract tests (tests/test_engine.py, the
+# "overlapped (stall-free) admission" section: byte-exact parity with
+# overlap_admission on/off, cancel/deadline-during-inflight-prefill,
+# flood back-pressure) are deliberately NOT marked 'slow': they are the
+# correctness gate for the deferred-fetch admission path and must run in
+# every tier-1 pass (~45 s of the budget on CPU).
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
