@@ -636,12 +636,20 @@ class QuantizedDenseKVCache(_DenseRowsMixin, struct.PyTreeNode):
         it out head-major."""
         k_q, k_s = _quantize_kv(ks)  # [L, 1, S, H, D] / [L, 1, S, H]
         v_q, v_s = _quantize_kv(vs)
-        k_q = jnp.moveaxis(k_q, 2, 3)  # [L, 1, H, S, D]
-        v_q = jnp.moveaxis(v_q, 2, 3)
-        k_s = jnp.swapaxes(k_s, 2, 3)  # [L, 1, H, S]
-        v_s = jnp.swapaxes(v_s, 2, 3)
+        return self.ingest_planes_row(k_q, v_q, k_s, v_s, n_valid)
+
+    def ingest_planes_row(self, k_q, v_q, k_s, v_s, n_valid):
+        """Install ALREADY-quantized time-major planes (int8 values
+        ``[L, B, S, Hkv, D]`` + f32 scales ``[L, B, S, Hkv]``) without
+        requantizing: disaggregated decode imports the prefill pool's
+        STORED planes bit-exact — quantizing a dequantized copy would
+        not round-trip."""
+        k_q = jnp.moveaxis(jnp.asarray(k_q), 2, 3)  # [L, 1, H, S, D]
+        v_q = jnp.moveaxis(jnp.asarray(v_q), 2, 3)
+        k_s = jnp.swapaxes(jnp.asarray(k_s), 2, 3)  # [L, 1, H, S]
+        v_s = jnp.swapaxes(jnp.asarray(v_s), 2, 3)
         t = self.max_len
-        s = ks.shape[2]
+        s = k_q.shape[3]
 
         def fit(a):
             if s >= t:

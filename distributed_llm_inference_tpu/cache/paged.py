@@ -585,16 +585,31 @@ class PageAllocator:
         Iterates in REVERSE so a prefix chain's deepest chunks enter the LRU
         first (oldest): eviction then trims chains from the tail, keeping a
         usable shorter prefix — evicting the chain root first would orphan
-        every deeper cached page."""
-        for p in reversed(list(pages)):
+        every deeper cached page.
+
+        The whole list is validated BEFORE any state changes: a bad id must
+        raise with the pool untouched, not after earlier pages in the list
+        were already freed/decref'd (a caught exception would otherwise
+        leave refcounts inconsistent with the caller's page lists)."""
+        pages = list(pages)
+        drops: Dict[int, int] = {}
+        for p in pages:
             if not 0 < p < self.num_pages:
                 raise ValueError(
                     f"page {p} outside pool (1..{self.num_pages - 1}; 0 is the "
                     "reserved null page)"
                 )
+            drops[p] = drops.get(p, 0) + 1
             refs = self._refs.get(p)
-            if refs is None or refs == 0 or p in self._free_set:
+            if (
+                refs is None
+                or refs == 0
+                or p in self._free_set
+                or drops[p] > refs  # duplicates within ONE call over-release
+            ):
                 raise ValueError(f"double free of page {p}")
+        for p in reversed(pages):
+            refs = self._refs[p]
             if refs > 1:
                 self._refs[p] = refs - 1
                 continue
@@ -679,6 +694,13 @@ class QuantizedPagedKVCache(PagedKVCache):
 
         k_q, k_s = _quantize_kv(ks)  # [L, 1, S, H, D] / [L, 1, S, H]
         v_q, v_s = _quantize_kv(vs)
+        return self.ingest_planes_row(k_q, v_q, k_s, v_s, n_valid)
+
+    def ingest_planes_row(self, k_q, v_q, k_s, v_s, n_valid):
+        """Install ALREADY-quantized planes (int8 values ``[L, 1, S, H, D]``
+        + f32 scales ``[L, 1, S, H]``) without requantizing — disaggregated
+        decode imports the prefill pool's STORED planes bit-exact (cf.
+        ``QuantizedDenseKVCache.ingest_planes_row``)."""
         return self._ingest_planes(
             {"k_pages": k_q, "v_pages": v_q,
              "ks_pages": k_s, "vs_pages": v_s},

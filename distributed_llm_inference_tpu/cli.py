@@ -12,6 +12,9 @@ Subcommands map onto the deployment roles:
 * ``api``       HTTP gateway: OpenAI-compatible ``/v1/completions`` (JSON +
                 SSE streaming) over the local engine, or over the relay
                 chain with ``--relay``; ``/metrics`` + ``/healthz`` included
+* ``prefill``   disaggregated serving: prefill-pool worker — full model,
+                prefill + first token only, ships KV planes to
+                ``api --disagg`` gateways over the relay
 * ``chaos``     fault-injecting TCP proxy in front of a relay hub: point
                 endpoints at its port and replay a seeded failure schedule
 * ``info``      inspect a checkpoint (config, layer count, shard files)
@@ -25,6 +28,8 @@ Examples::
     distribute local --model /ckpt/llama --prompt-ids 1,2,3 --max-new 32
     distribute api --model /ckpt/llama --port 8000
     distribute api --model /ckpt/llama --port 8000 --relay :18900
+    distribute prefill --model /ckpt/llama --relay :18900
+    distribute api --model /ckpt/llama --port 8000 --relay :18900 --disagg
     distribute chaos --upstream :18900 --port 18901 --seed 7 \\
         --fault 'drop:block.*:put:after=5,count=2' --fault 'sever:*:any'
 """
@@ -184,6 +189,56 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_prefill(args) -> int:
+    """Run a prefill-pool worker for disaggregated serving: a full-model
+    engine that only ever prefills prompts (+ samples the first token) and
+    ships the resulting KV planes to ``api --disagg`` gateways."""
+    import jax.numpy as jnp
+
+    from .config import CacheConfig, DisaggConfig, EngineConfig
+    from .disagg.prefill_worker import PrefillWorker
+    from .engine.engine import InferenceEngine
+    from .utils import checkpoint
+
+    host, port = _parse_relay(args.relay)
+    resolve, _ = _model_source(args)
+    cfg = checkpoint.load_config(args.model, resolve=resolve)
+    params = checkpoint.load_model_params(
+        args.model, cfg, jnp.dtype(args.dtype), resolve=resolve,
+        cache_dir=args.weights_cache,
+    )
+    engine = InferenceEngine(
+        cfg, params,
+        EngineConfig(
+            max_batch_size=args.max_sessions, max_seq_len=args.max_seq_len,
+            dtype=args.dtype, quantization=args.quantize,
+        ),
+        # The cache config MUST match the decode pool's (quantized KV ships
+        # as stored int8+scales; the gateway rejects a quantization
+        # mismatch at admission).
+        CacheConfig(kind=args.cache, kv_quant=args.kv_quant,
+                    page_size=args.page_size, num_pages=args.num_pages),
+    )
+    worker = PrefillWorker(
+        port, engine, host=host, node_id=args.node_id,
+        disagg_cfg=DisaggConfig(kv_frame_bytes=args.kv_frame_bytes),
+        lease_ttl=args.lease_ttl,
+    )
+    print(json.dumps({
+        "event": "prefill_up", "node_id": worker.node_id,
+        "queue": worker.queue,
+    }), flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop and worker.is_healthy():
+            time.sleep(0.2)
+    finally:
+        worker.stop()
+    return 0
+
+
 def cmd_generate(args) -> int:
     import jax.numpy as jnp
 
@@ -298,9 +353,13 @@ def cmd_local(args) -> int:
 def cmd_api(args) -> int:
     import jax.numpy as jnp
 
-    from .config import CacheConfig, EngineConfig, ServingConfig
-    from .serving import ApiServer, ClientBackend, EngineBackend
+    from .config import CacheConfig, DisaggConfig, EngineConfig, ServingConfig
+    from .serving import ApiServer, ClientBackend, DisaggBackend, EngineBackend
     from .utils import checkpoint
+
+    if args.disagg and not args.relay:
+        raise SystemExit("--disagg needs --relay (the prefill pool and the "
+                         "KV transfer both ride the relay hub)")
 
     tokenizer = None
     if args.tokenizer:
@@ -324,7 +383,36 @@ def cmd_api(args) -> int:
         breaker_recovery_s=args.breaker_recovery,
         breaker_probe_interval_s=args.breaker_probe_interval,
     )
-    if args.relay:
+    if args.disagg:
+        # Disaggregated serving: the local engine is the DECODE pool
+        # member; prompt prefill routes to role="prefill" workers (the
+        # ``prefill`` subcommand) through the relay, with local-prefill
+        # fallback when the pool is empty or a transfer fails.
+        from .engine.engine import InferenceEngine
+
+        host, port = _parse_relay(args.relay)
+        params = checkpoint.load_model_params(
+            args.model, cfg, jnp.dtype(args.dtype), resolve=resolve,
+            cache_dir=args.weights_cache,
+        )
+        engine = InferenceEngine(
+            cfg, params,
+            EngineConfig(
+                max_batch_size=args.max_sessions,
+                max_seq_len=args.max_seq_len, dtype=args.dtype,
+                quantization=args.quantize,
+            ),
+            CacheConfig(kind=args.cache, kv_quant=args.kv_quant),
+        )
+        backend = DisaggBackend(
+            engine, port, relay_host=host,
+            disagg_cfg=DisaggConfig(
+                kv_frame_bytes=args.kv_frame_bytes,
+                transfer_timeout_s=args.transfer_timeout,
+            ),
+            idle_sleep_s=scfg.idle_sleep_s,
+        )
+    elif args.relay:
         from .distributed.client import DistributedClient
 
         host, port = _parse_relay(args.relay)
@@ -476,6 +564,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "protocol is unchanged)")
     s.set_defaults(fn=cmd_serve)
 
+    pf = sub.add_parser(
+        "prefill",
+        help="disaggregated serving: prefill-pool worker (full model, "
+             "prefill + first token only; ships KV to api --disagg)",
+    )
+    pf.add_argument("--model", required=True)
+    pf.add_argument("--relay", required=True, help="host:port of the relay")
+    pf.add_argument("--node-id", default=None)
+    pf.add_argument("--lease-ttl", type=float, default=10.0)
+    pf.add_argument("--max-sessions", type=int, default=8)
+    pf.add_argument("--max-seq-len", type=int, default=2048)
+    pf.add_argument("--dtype", default="bfloat16")
+    pf.add_argument("--quantize", default=None,
+                    choices=("int8", "int4", "int8_outlier"))
+    pf.add_argument("--cache", default="paged", choices=("paged", "dense"),
+                    help="must match the decode pool (sink caches can't "
+                         "export whole-prompt KV)")
+    pf.add_argument("--kv-quant", default=None, choices=("int8",),
+                    help="must match the decode pool's KV quantization")
+    pf.add_argument("--page-size", type=int, default=64)
+    pf.add_argument("--num-pages", type=int, default=512)
+    pf.add_argument("--kv-frame-bytes", type=int, default=4 * 1024 * 1024,
+                    help="max relay frame payload for shipped KV planes")
+    pf.add_argument("--weights-cache", default=None,
+                    help="directory for pre-converted weight caching")
+    pf.set_defaults(fn=cmd_prefill)
+
     g = sub.add_parser("generate", help="generate through registered nodes")
     g.add_argument("--model", required=True)
     g.add_argument("--relay", required=True)
@@ -538,6 +653,17 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--relay", default=None,
                    help="host:port of a relay: serve through the "
                         "distributed chain instead of a local engine")
+    a.add_argument("--disagg", action="store_true",
+                   help="with --relay: disaggregated prefill/decode — the "
+                        "local engine decodes, admission routes prompts to "
+                        "``prefill`` workers and imports their shipped KV "
+                        "(falls back to local prefill on any failure)")
+    a.add_argument("--transfer-timeout", type=float, default=30.0,
+                   help="with --disagg: seconds to wait for a prefill "
+                        "worker's KV frames before falling back locally")
+    a.add_argument("--kv-frame-bytes", type=int, default=4 * 1024 * 1024,
+                   help="with --disagg: max relay frame payload requested "
+                        "for shipped KV planes")
     a.add_argument("--client-batch", type=int, default=0,
                    help="with --relay: group up to N admitted requests "
                         "into one batched decode loop (generate_many) so "

@@ -319,3 +319,30 @@ class ServingConfig:
     # Background backend health-probe period (seconds; 0 disables). Probes
     # can open the breaker with zero traffic and drive recovery.
     breaker_probe_interval_s: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DisaggConfig:
+    """Disaggregated prefill/decode policy (``disagg/``, ``serving``'s
+    ``DisaggBackend``): how the gateway ships prompts to the prefill pool
+    and imports the returned KV planes into the local decode engine."""
+
+    # Max bytes of KV payload per relay frame. The codec splits a session's
+    # plane blob into ceil(total/kv_frame_bytes) frames so one transfer
+    # never monopolizes the relay socket (and stays under any frame cap a
+    # deployment configures on the hub).
+    kv_frame_bytes: int = 4 * 1024 * 1024
+    # End-to-end budget for one prefill+transfer round trip (request put ->
+    # last KV frame). On expiry the gateway abandons the transfer and falls
+    # back to local prefill — a slow pool degrades, never wedges.
+    transfer_timeout_s: float = 30.0
+    # How long submit() waits for a prefill-role node to appear in the
+    # directory before falling back locally (0 = don't wait: an empty pool
+    # falls back immediately).
+    prefill_wait_s: float = 0.0
+    # Degrade to local prefill on any transfer/admission failure. Disabled,
+    # failures surface as terminal error events instead (strict mode for
+    # capacity experiments where silent local prefill would skew numbers).
+    fallback_local: bool = True
+    # Prefill worker lease heartbeat period (seconds).
+    heartbeat_s: float = 2.0

@@ -1,0 +1,21 @@
+"""Disaggregated prefill/decode (TPLA, arxiv 2508.15881): prefill-only
+workers run bucketed prefill + the first-token sample, then ship the
+session's KV planes over the CRC-checked relay to a decode-pool engine
+that imports them via ``admit_prefilled`` and enters decode directly.
+
+Pieces:
+
+* :mod:`.kv_codec` — chunked (de)serialization of per-layer KV planes
+  (bf16 values, or int8 values + f32 scales from the quantized caches)
+  into relay frames.
+* :mod:`.prefill_worker` — the prefill-only role: registers with the
+  block directory under ``role="prefill"``, consumes prompt requests,
+  and answers with KV frames (or a single error frame).
+
+The gateway side lives in ``serving.backends.DisaggBackend``.
+"""
+
+from .kv_codec import decode_kv, encode_error, encode_kv
+from .prefill_worker import PrefillWorker
+
+__all__ = ["encode_kv", "decode_kv", "encode_error", "PrefillWorker"]

@@ -1,0 +1,178 @@
+"""KV-plane wire codec for disaggregated prefill ("Move the Query, Not
+the Cache", arxiv 2606.01502, characterizes the redistribution cost this
+engineers around).
+
+A prefill worker exports a session's KV as contiguous per-layer planes —
+``{"k": [L, S, Hkv, D], "v": ...}`` for value caches, plus
+``{"ks": [L, S, Hkv], "vs": ...}`` f32 scales when the source cache is
+int8-quantized. The codec serializes the planes into ONE payload blob
+(per-plane records, each length-prefixed so ragged dtypes coexist) and
+splits it into relay frames of at most ``max_frame_bytes`` payload each.
+
+Frame layout mirrors ``distributed.messages.pack_frame``::
+
+    [header_len:4 BE][JSON header][payload chunk]
+
+Every frame carries the full metadata header — ``gens`` (session ids),
+``n_valid`` (tokens of valid KV), ``first_token``, ``quant``, ``chain``
+(prompt hash chain, hex), ``ps`` (chain page size), ``dtypes``, frame
+index ``i`` of ``n``, and a CRC-32 + total length over the whole blob —
+so a receiver can detect loss, duplication, truncation, and reordering
+without trusting frame arrival order. The relay's own per-frame CRC
+handles transport corruption (a corrupt frame is dropped at the socket
+layer and surfaces as a timeout here); the codec-level CRC guards the
+reassembly itself. Any integrity violation raises ``ValueError`` — the
+gateway treats that exactly like a timeout and falls back to local
+prefill.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..distributed.relay import RelayClient
+
+__all__ = ["encode_kv", "decode_kv", "encode_error"]
+
+VERSION = 1
+
+# Header keys that must agree across every frame of one transfer.
+_CONSISTENT = ("gens", "n", "n_valid", "first_token", "quant", "chain",
+               "ps", "crc", "total", "dtypes")
+
+
+def _pack(header: dict, chunk: bytes = b"") -> bytes:
+    hdr = json.dumps(header).encode()
+    return struct.pack(">I", len(hdr)) + hdr + chunk
+
+
+def _unpack(frame: bytes) -> Tuple[dict, bytes]:
+    if len(frame) < 4:
+        raise ValueError("kv frame shorter than its header length field")
+    (hlen,) = struct.unpack_from(">I", frame, 0)
+    if len(frame) < 4 + hlen:
+        raise ValueError("kv frame truncated inside its header")
+    header = json.loads(frame[4 : 4 + hlen].decode())
+    return header, frame[4 + hlen :]
+
+
+def _encode_plane(name: str, arr) -> bytes:
+    a = np.asarray(arr)
+    if a.dtype.name == "bfloat16":  # ml_dtypes: ship raw bits (relay idiom)
+        body = RelayClient.encode_array(a.view(np.uint16), "bfloat16")
+    else:
+        body = RelayClient.encode_array(a)
+    nb = name.encode()
+    return struct.pack(">B", len(nb)) + nb + struct.pack(">Q", len(body)) + body
+
+
+def encode_kv(
+    gen_id: str,
+    planes: Dict[str, "np.ndarray"],
+    n_valid: int,
+    first_token: int,
+    chain: Sequence[bytes] = (),
+    *,
+    page_size: int = 0,
+    quant: bool = False,
+    max_frame_bytes: int = 4 * 1024 * 1024,
+) -> List[bytes]:
+    """Serialize one session's KV planes into an ordered list of frames."""
+    payload = b"".join(_encode_plane(k, v) for k, v in planes.items())
+    step = max(int(max_frame_bytes), 1)
+    chunks = [payload[i : i + step] for i in range(0, len(payload), step)]
+    if not chunks:
+        chunks = [b""]
+    header = {
+        "v": VERSION,
+        "gens": [gen_id],
+        "n": len(chunks),
+        "n_valid": int(n_valid),
+        "first_token": int(first_token),
+        "quant": bool(quant),
+        "chain": [c.hex() for c in chain],
+        "ps": int(page_size),
+        "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+        "total": len(payload),
+        "dtypes": {k: np.asarray(v).dtype.name for k, v in planes.items()},
+    }
+    return [_pack(dict(header, i=i), c) for i, c in enumerate(chunks)]
+
+
+def encode_error(gen_id: str, code: str) -> bytes:
+    """Single error frame a prefill worker answers with on failure, so the
+    gateway falls back immediately instead of waiting out its timeout."""
+    return _pack({"v": VERSION, "gens": [gen_id], "error": code, "n": 1,
+                  "i": 0})
+
+
+def decode_kv(
+    frames: Iterable[bytes],
+) -> Tuple[Optional[Dict[str, "np.ndarray"]], dict]:
+    """Reassemble and validate frames from :func:`encode_kv`.
+
+    Returns ``(planes, meta)`` with ``meta["chain"]`` back as ``bytes``
+    keys. An error frame returns ``(None, meta)`` with ``meta["error"]``
+    set. Raises ``ValueError`` on any integrity violation: version skew,
+    duplicate/missing/out-of-range frame index, inconsistent headers,
+    length or CRC mismatch, or a malformed plane record.
+    """
+    base: Optional[dict] = None
+    chunks: Dict[int, bytes] = {}
+    for frame in frames:
+        header, chunk = _unpack(frame)
+        if header.get("v") != VERSION:
+            raise ValueError(f"kv codec version skew: {header.get('v')!r}")
+        if "error" in header:
+            return None, header
+        i = header.get("i")
+        if base is None:
+            base = {k: header.get(k) for k in _CONSISTENT}
+            if None in (base["n"], base["crc"], base["total"]):
+                raise ValueError("kv frame header missing required fields")
+        elif any(header.get(k) != base[k] for k in _CONSISTENT):
+            raise ValueError("kv frames disagree on transfer metadata")
+        if not isinstance(i, int) or not 0 <= i < base["n"]:
+            raise ValueError(f"kv frame index {i!r} outside 0..{base['n']}")
+        if i in chunks:
+            raise ValueError(f"duplicate kv frame {i}")
+        chunks[i] = chunk
+    if base is None:
+        raise ValueError("empty kv transfer")
+    if len(chunks) != base["n"]:
+        missing = sorted(set(range(base["n"])) - set(chunks))
+        raise ValueError(f"kv transfer missing frames {missing}")
+    payload = b"".join(chunks[i] for i in range(base["n"]))
+    if len(payload) != base["total"]:
+        raise ValueError(
+            f"kv payload length {len(payload)} != declared {base['total']}"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != base["crc"]:
+        raise ValueError("kv payload CRC mismatch")
+    planes: Dict[str, np.ndarray] = {}
+    off = 0
+    while off < len(payload):
+        (nlen,) = struct.unpack_from(">B", payload, off)
+        off += 1
+        name = payload[off : off + nlen].decode()
+        off += nlen
+        (blen,) = struct.unpack_from(">Q", payload, off)
+        off += 8
+        body = payload[off : off + blen]
+        off += blen
+        if len(body) != blen:
+            raise ValueError(f"kv plane {name!r} record truncated")
+        arr, dtype = RelayClient.decode_array(body)
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            arr = arr.view(ml_dtypes.bfloat16)
+        planes[name] = arr
+    meta = dict(base)
+    meta["chain"] = [bytes.fromhex(c) for c in meta.get("chain") or []]
+    return planes, meta

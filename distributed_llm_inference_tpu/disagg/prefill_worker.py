@@ -1,0 +1,193 @@
+"""Prefill-only worker: the admission half of disaggregated serving.
+
+A :class:`PrefillWorker` wraps a full-model :class:`InferenceEngine` used
+ONLY for bucketed prefill + the first-token sample: it registers with the
+block directory under ``role="prefill"`` (so it never appears in decode
+layer routes), consumes prompt requests off its relay queue, and answers
+each with the session's KV planes as :mod:`.kv_codec` frames — or a
+single error frame, so the gateway falls back to local prefill instead of
+waiting out its transfer timeout.
+
+Request frame (``messages.pack_frame`` JSON header, no array)::
+
+    {"op": "prefill", "gen": <gateway id>, "reply": <reply queue>,
+     "prompt": [int, ...], "options": {SamplingOptions fields},
+     "max_frame_bytes": int}
+
+``op: "shutdown"`` stops the worker (tests). Anything malformed is
+dropped — a poisoned frame must not kill the pool member.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import uuid
+from typing import Optional
+
+from ..config import DisaggConfig
+from ..distributed.directory import DirectoryClient
+from ..distributed.messages import unpack_frame
+from ..distributed.relay import RelayClient
+from ..engine.sampling import SamplingOptions
+from .kv_codec import encode_error, encode_kv
+
+__all__ = ["PrefillWorker"]
+
+logger = logging.getLogger("distributed_llm_inference_tpu")
+
+_OPT_FIELDS = {f.name for f in dataclasses.fields(SamplingOptions)}
+
+
+def _options_from(payload) -> SamplingOptions:
+    kw = {
+        k: v for k, v in (payload or {}).items() if k in _OPT_FIELDS
+    }
+    return SamplingOptions(**kw)
+
+
+class PrefillWorker:
+    """Serve ``prefill_export`` over the relay (background threads)."""
+
+    def __init__(
+        self,
+        relay_port: int,
+        engine,
+        host: str = "127.0.0.1",
+        node_id: Optional[str] = None,
+        disagg_cfg: Optional[DisaggConfig] = None,
+        lease_ttl: float = 10.0,
+    ):
+        self.engine = engine
+        self.node_id = node_id or f"prefill-{uuid.uuid4().hex[:8]}"
+        self.queue = f"prefill.{self.node_id}"
+        self.host, self.relay_port = host, relay_port
+        self.dcfg = disagg_cfg or DisaggConfig()
+        self.lease_ttl = lease_ttl
+        self.metrics = engine.metrics
+        self._stop = threading.Event()
+        self._busy = 0  # directory load hint (heartbeat thread reads it)
+        # Register FIRST (mirrors ServingNode): a directory/relay failure
+        # here must not leak threads or sockets.
+        self._directory = DirectoryClient(relay_port, host)
+        try:
+            self._register()
+            self._out = RelayClient(host, relay_port)
+        except Exception:
+            self._directory.close()
+            raise
+        self._consume_thread = threading.Thread(
+            target=self._consume, daemon=True, name=f"{self.node_id}.consume"
+        )
+        self._consume_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name=f"{self.node_id}.health",
+        )
+        self._health_thread.start()
+
+    def _register(self) -> None:
+        # A prefill worker holds the FULL model (it runs whole-prompt
+        # prefill), so its advertised range is every layer; the role keeps
+        # it out of decode routes regardless.
+        self._directory.register(
+            self.node_id, 0, self.engine.cfg.num_layers - 1, self.queue,
+            ttl=self.lease_ttl, role="prefill",
+        )
+
+    # -- serve loop -----------------------------------------------------------
+
+    def _consume(self) -> None:
+        client = RelayClient(self.host, self.relay_port)
+        try:
+            while not self._stop.is_set():
+                try:
+                    frame = client.get(self.queue, timeout=0.5)
+                except TimeoutError:
+                    continue
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    header, _ = unpack_frame(frame)
+                    op = header.get("op")
+                except Exception:
+                    continue  # malformed frame: drop, keep serving
+                if op == "shutdown":
+                    return
+                if op != "prefill":
+                    continue
+                reply = header.get("reply")
+                if not reply:
+                    continue  # nowhere to answer — drop
+                self._busy += 1
+                try:
+                    self._handle(header, reply)
+                finally:
+                    self._busy -= 1
+        finally:
+            client.close()
+
+    def _handle(self, header: dict, reply: str) -> None:
+        gen = str(header.get("gen", ""))
+        try:
+            prompt = [int(t) for t in header["prompt"]]
+            opts = _options_from(header.get("options"))
+            planes, first, chain = self.engine.prefill_export(prompt, opts)
+            frames = encode_kv(
+                gen, planes, len(prompt), first, chain,
+                page_size=self.engine.ccfg.page_size,
+                quant="ks" in planes,
+                max_frame_bytes=int(
+                    header.get("max_frame_bytes")
+                    or self.dcfg.kv_frame_bytes
+                ),
+            )
+            self.metrics.counter("disagg_kv_frames_sent", len(frames))
+        except Exception as e:  # answer with an error, never wedge the peer
+            logger.warning(
+                "prefill %s failed on %s: %r", gen, self.node_id, e
+            )
+            self.metrics.counter("disagg_prefill_errors")
+            try:
+                self._out.put(reply, encode_error(gen, repr(e)))
+            except (ConnectionError, OSError):
+                pass
+            return
+        try:
+            self._out.put_many((reply, f) for f in frames)
+        except (ConnectionError, OSError):
+            pass  # gateway times out and falls back locally
+
+    # -- health ---------------------------------------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.dcfg.heartbeat_s):
+            try:
+                alive = self._directory.heartbeat(
+                    self.node_id, load=self._busy, ttl=self.lease_ttl
+                )
+                if not alive:  # lease lapsed (e.g. directory restart)
+                    self._register()
+            except Exception:
+                continue  # transient control-plane failure: keep serving
+
+    def is_healthy(self) -> bool:
+        return self._consume_thread.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consume_thread.join(timeout=5)
+        self._health_thread.join(timeout=5)
+        try:
+            self._directory.remove(self.node_id)
+        except Exception:
+            pass
+        self._directory.close()
+        self._out.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

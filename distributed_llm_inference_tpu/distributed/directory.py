@@ -41,6 +41,10 @@ class NodeInfo:
     # next joiner is steered elsewhere) but never toward ROUTING (there is
     # no queue to send to until the node loads its weights and registers).
     pending: bool = False
+    # Serving role: "both" (classic block worker), "decode" (decode-pool
+    # member), or "prefill" (prefill-only worker — excluded from layer
+    # routes; disaggregated gateways pick it by role instead).
+    role: str = "both"
 
     def covers(self, layer: int) -> bool:
         return self.first_layer <= layer <= self.last_layer
@@ -57,14 +61,41 @@ class BlockDirectory:
 
     def register(
         self, node_id: str, first_layer: int, last_layer: int, queue: str,
-        ttl: Optional[float] = None,
+        ttl: Optional[float] = None, role: str = "both",
     ) -> None:
         if last_layer < first_layer:
             raise ValueError(f"bad layer range [{first_layer}, {last_layer}]")
+        if role not in ("both", "decode", "prefill"):
+            raise ValueError(f"bad role {role!r}")
         with self._lock:
+            # A real node arriving retires ONE matching pending reservation
+            # immediately (the provisional lease assign() parked on this
+            # range): leaving it to TTL out would double-count the range in
+            # assign()'s coverage math and steer the next joiner away from
+            # a hole that is in fact still open. Exact range match wins;
+            # otherwise any reservation fully covered by the new node.
+            for exact_only in (True, False):
+                rid = next(
+                    (
+                        r for r, n in self._nodes.items()
+                        if n.pending
+                        and (
+                            (n.first_layer, n.last_layer)
+                            == (first_layer, last_layer)
+                            if exact_only
+                            else (first_layer <= n.first_layer
+                                  and n.last_layer <= last_layer)
+                        )
+                    ),
+                    None,
+                )
+                if rid is not None:
+                    del self._nodes[rid]
+                    break
             self._nodes[node_id] = NodeInfo(
                 node_id, first_layer, last_layer, queue,
                 time.monotonic() + (ttl or self.default_ttl),
+                role=role,
             )
 
     def heartbeat(self, node_id: str, load: int = 0, ttl: Optional[float] = None) -> bool:
@@ -150,7 +181,11 @@ class BlockDirectory:
         pick the live node extending coverage furthest (least-loaded on
         ties). Raises if there is a gap — the health signal a client acts on.
         """
-        nodes = [n for n in self.alive() if not n.pending]
+        # Prefill-only workers never appear in layer routes: they hold full
+        # weights but serve the admission phase, not the decode chain.
+        nodes = [
+            n for n in self.alive() if not n.pending and n.role != "prefill"
+        ]
         route: List[NodeInfo] = []
         layer = 0
         while layer < num_layers:
@@ -204,7 +239,8 @@ class DirectoryService:
             op = req["op"]
             if op == "register":
                 d.register(req["node_id"], req["first_layer"],
-                           req["last_layer"], req["queue"], req.get("ttl"))
+                           req["last_layer"], req["queue"], req.get("ttl"),
+                           req.get("role", "both"))
                 return {"ok": True}
             if op == "heartbeat":
                 ok = d.heartbeat(req["node_id"], req.get("load", 0),
@@ -230,7 +266,7 @@ class DirectoryService:
                 return {"ok": True, "nodes": [
                     {"node_id": n.node_id, "first_layer": n.first_layer,
                      "last_layer": n.last_layer, "queue": n.queue,
-                     "load": n.load, "pending": n.pending}
+                     "load": n.load, "pending": n.pending, "role": n.role}
                     for n in d.alive()
                 ]}
             return {"ok": False, "error": f"unknown op {op!r}"}
@@ -282,10 +318,11 @@ class DirectoryClient:
         return reply
 
     def register(self, node_id: str, first_layer: int, last_layer: int,
-                 queue: str, ttl: Optional[float] = None) -> None:
+                 queue: str, ttl: Optional[float] = None,
+                 role: str = "both") -> None:
         self._call({"op": "register", "node_id": node_id,
                     "first_layer": first_layer, "last_layer": last_layer,
-                    "queue": queue, "ttl": ttl})
+                    "queue": queue, "ttl": ttl, "role": role})
 
     def heartbeat(self, node_id: str, load: int = 0,
                   ttl: Optional[float] = None) -> bool:

@@ -23,6 +23,7 @@ Step anatomy (host orchestrates, device computes):
 from __future__ import annotations
 
 import collections
+import dataclasses
 import math
 import threading
 import time
@@ -541,6 +542,11 @@ class InferenceEngine:
         # are byte-exact with ``overlap_admission`` on or off.
         self._inflight_admits: List[Tuple[List[Session], jax.Array, List[int]]] = []
         self._admit_pend = np.zeros(self.batch, np.int32)
+        # Events produced OUTSIDE step() (admit_prefilled's synchronous
+        # first-token delivery happens on a gateway thread): step() drains
+        # them into its own event list so streaming consumers see every
+        # token through the one event channel they already poll.
+        self._ext_produced: List[Tuple[str, int, bool]] = []
         # Any tail-capable cache pipelines (dense kinds and the paged pools'
         # fused windows); the sink ring (no tail) and draft-model engines
         # keep the synchronous flow.
@@ -1120,6 +1126,9 @@ class InferenceEngine:
         tokens arrive one ``step()`` later than they were dispatched."""
         produced: List[Tuple[str, int, bool]] = []
         with self._lock:
+            if self._ext_produced:
+                produced.extend(self._ext_produced)
+                self._ext_produced.clear()
             if self._pipelined:
                 prev = self._pending
                 self._pending = self._dispatch_tick(produced, prev)
@@ -1148,6 +1157,7 @@ class InferenceEngine:
                 or any(s is not None for s in self.slots)
                 or self._pending is not None
                 or bool(self._inflight_admits)
+                or bool(self._ext_produced)
                 or getattr(self, "_spec_pending", None) is not None
             )
 
@@ -1193,6 +1203,239 @@ class InferenceEngine:
             for gid in done:
                 del self.sessions[gid]
             return done
+
+    # -- disaggregated prefill/decode (disagg/) -------------------------------
+
+    def prefill_export(self, prompt, options=None):
+        """Prefill-pool entry point: run ONE prompt's bucketed admission
+        prefill on this engine, sample its first token, and export
+        ``(planes, first_token, chain)`` for a remote decode pool — then
+        release the row (the session never decodes here).
+
+        ``planes`` is :meth:`export_kv_row`'s host dict; ``chain`` is the
+        prompt's page-granular hash chain (``PageAllocator.chain_keys``
+        over ``CacheConfig.page_size``), shipped so the importer can verify
+        the KV answers the prompt it asked about. Lifecycle knobs
+        (eos/max_new_tokens) are neutralized for the local run — the
+        decode pool owns those decisions, and a first token that happened
+        to hit eos must not finish-and-free the row before its KV is
+        exported. Sampling knobs pass through untouched, so the sampled
+        first token is byte-identical to a colocated engine's.
+
+        Raises ``RuntimeError`` when admission fails (capacity rejection
+        or page-pool pressure) — callers answer with an error frame and
+        the gateway falls back to local prefill."""
+        if isinstance(self.cache, _SINK_KINDS):
+            raise ValueError(
+                "disaggregated prefill unsupported for sink caches"
+            )
+        run_opts = dataclasses.replace(
+            options or SamplingOptions(),
+            max_new_tokens=1 << 30, eos_token_id=-1,
+        )
+        with self._lock:
+            produced: List[Tuple[str, int, bool]] = []
+            s = self._submit_session(prompt, run_opts)
+            try:
+                self._admit(produced)
+                if not s.generated:
+                    reason = s.finish_reason or "pool pressure"
+                    raise RuntimeError(
+                        f"prefill admission failed: {reason}"
+                    )
+                planes = self.export_kv_row(s)
+                chain = PageAllocator.chain_keys(
+                    s.prompt, self.ccfg.page_size
+                )
+                self.metrics.counter("disagg_prefills")
+                return planes, s.generated[0], chain
+            finally:
+                if s.slot is not None:
+                    s.state = SessionState.CANCELLED
+                    s.finish_reason = "exported"
+                    self._release(s)
+                else:
+                    # Capacity-rejected (already finished) or still queued
+                    # under pool pressure — drop the queue entry either way.
+                    try:
+                        self.waiting.remove(s)
+                    except ValueError:
+                        pass
+                self.sessions.pop(s.generation_id, None)
+
+    def export_kv_row(self, s: Session):
+        """Contiguous host copies of a resident session's prompt KV in the
+        STORED representation (so a same-config importer is bit-exact):
+        value planes ``[L, S, Hkv, D]`` under ``"k"``/``"v"`` — bf16 (or
+        engine dtype) for value caches, int8 for quantized ones, the
+        latter alongside f32 scale planes ``[L, S, Hkv]`` under
+        ``"ks"``/``"vs"``. ``S = len(s.prompt)``; keys are post-RoPE, as
+        cached. Caller holds the scheduler lock (or owns the engine)."""
+        n = len(s.prompt)
+        cache = self.cache
+        if isinstance(cache, PagedKVCache):
+            pages = jnp.asarray(np.asarray(s.pages, np.int32))
+
+            def vals(pool):  # [L,P,H,ps,D] -> [L,S,H,D]
+                a = jnp.transpose(pool[:, pages], (0, 1, 3, 2, 4))
+                a = a.reshape(a.shape[0], -1, *a.shape[3:])
+                return np.asarray(a[:, :n])
+
+            out = {"k": vals(cache.k_pages), "v": vals(cache.v_pages)}
+            if isinstance(cache, QuantizedPagedKVCache):
+
+                def scales(pool):  # [L,P,H,ps] -> [L,S,H]
+                    a = jnp.transpose(pool[:, pages], (0, 1, 3, 2))
+                    a = a.reshape(a.shape[0], -1, a.shape[3])
+                    return np.asarray(a[:, :n])
+
+                out["ks"] = scales(cache.ks_pages)
+                out["vs"] = scales(cache.vs_pages)
+            return out
+        if isinstance(cache, QuantizedDenseKVCache):
+            return {  # head-major [L,B,H,T,D] -> time-major [L,S,H,D]
+                "k": np.asarray(jnp.swapaxes(cache.k[:, s.slot, :, :n], 1, 2)),
+                "v": np.asarray(jnp.swapaxes(cache.v[:, s.slot, :, :n], 1, 2)),
+                "ks": np.asarray(jnp.swapaxes(cache.ks[:, s.slot, :, :n], 1, 2)),
+                "vs": np.asarray(jnp.swapaxes(cache.vs[:, s.slot, :, :n], 1, 2)),
+            }
+        if isinstance(cache, DenseKVCache):
+            return {
+                "k": np.asarray(cache.k[:, s.slot, :n]),
+                "v": np.asarray(cache.v[:, s.slot, :n]),
+            }
+        raise ValueError(
+            f"KV export unsupported for {type(cache).__name__}"
+        )
+
+    def admit_prefilled(
+        self,
+        prompt: Sequence[int],
+        planes,
+        first_token: int,
+        options: Optional[SamplingOptions] = None,
+        deadline: Optional[float] = None,
+    ) -> Optional[str]:
+        """Admit a session whose prompt KV was prefilled REMOTELY: allocate
+        a row (and pages), ingest the shipped planes into a batch-1 view,
+        seed the prefix cache from the imported prompt pages, and enter
+        decode directly — delivering ``first_token`` through the overlap
+        machinery (``_defer_admit``) when a pipelined tick is in flight so
+        the import never stalls it, else synchronously via the external
+        event buffer ``step()`` drains.
+
+        Returns the generation_id, or ``None`` when no slot (or page-pool
+        headroom) is free right now — back-pressure the caller resolves by
+        falling back to a local :meth:`submit`. Raises ``ValueError`` when
+        the planes are structurally incompatible with this engine (wrong
+        quantization, shape, or cache family)."""
+        if isinstance(self.cache, _SINK_KINDS):
+            raise ValueError(
+                "disaggregated admission unsupported for sink caches"
+            )
+        if self.mesh is not None:
+            raise ValueError("disaggregated admission is single-device only")
+        if self.draft is not None:
+            raise ValueError(
+                "disaggregated admission incompatible with a draft model"
+            )
+        prompt = list(prompt)
+        n = len(prompt)
+        if n == 0:
+            raise ValueError("empty prompt")
+        quant = isinstance(
+            self.cache, (QuantizedPagedKVCache, QuantizedDenseKVCache)
+        )
+        want = {"k", "v", "ks", "vs"} if quant else {"k", "v"}
+        if set(planes) != want:
+            raise ValueError(
+                f"KV planes {sorted(planes)} do not match this cache "
+                f"(want {sorted(want)}: quantization must agree across pools)"
+            )
+        shape = (
+            self.cfg.num_layers, n, self.cfg.num_kv_heads, self.cfg.head_dim,
+        )
+        for name in sorted(want):
+            expect = shape if name in ("k", "v") else shape[:3]
+            got = tuple(np.asarray(planes[name]).shape)
+            if got != expect:
+                raise ValueError(
+                    f"KV plane {name!r} shape {got} != expected {expect}"
+                )
+        dev = {name: jnp.asarray(planes[name])[:, None] for name in want}
+        with self._lock:
+            slot = next(
+                (i for i in range(self.batch) if self.slots[i] is None), None
+            )
+            if slot is None:
+                return None
+            s = Session(
+                prompt=prompt,
+                options=options or SamplingOptions(),
+                deadline=deadline,
+            )
+            s.disagg = True
+            if not self._capacity_ok(s):
+                raise ValueError(
+                    "prompt exceeds this engine's per-session capacity"
+                )
+            self._ensure_capacity(n + 1)
+            self.cache = self.cache.reset_rows(jnp.arange(self.batch) == slot)
+            if isinstance(self.cache, PagedKVCache):
+                ps = self.ccfg.page_size
+                need = math.ceil((n + 1) / ps)
+                if need > self.allocator.free_count:
+                    return None  # pool pressure: same signal as a full batch
+                s.pages = self.allocator.alloc(need)
+                for i, pg in enumerate(s.pages):
+                    self._queue_install(slot, i, pg)
+                self._flush_installs()  # the ingest scatter reads the table
+                sub = self.cache.select_row(slot)
+                if quant:
+                    sub = sub.ingest_planes_row(
+                        dev["k"], dev["v"], dev["ks"], dev["vs"], n
+                    )
+                else:
+                    sub = sub.ingest_row(dev["k"], dev["v"], n)
+                self.cache = self.cache.merge_row(sub, slot)
+                if self.ccfg.prefix_caching:
+                    # Imported prompt pages seed the prefix cache exactly
+                    # like locally prefilled ones.
+                    s.prefix_keys = PageAllocator.chain_keys(prompt, ps)
+                    for i, key in enumerate(s.prefix_keys):
+                        self.allocator.register(s.pages[i], key)
+            else:
+                sub = self.cache.select_row(slot)
+                if quant:
+                    sub = sub.ingest_planes_row(
+                        dev["k"], dev["v"], dev["ks"], dev["vs"], n
+                    )
+                else:
+                    sub = sub.ingest_row(dev["k"], dev["v"], n)
+                self.cache = self.cache.merge_row(sub, slot)
+            self.sessions[s.generation_id] = s
+            s.slot = slot
+            s.state = SessionState.ACTIVE
+            self.slots[slot] = s.generation_id
+            self.metrics.counter("sessions_submitted")
+            self.metrics.counter("disagg_admitted")
+            # Consume the RNG split a local prefill would have spent on its
+            # first-token sample: the decode-tick key sequence then matches
+            # a colocated engine's byte-for-byte (sampled-parity contract).
+            self._next_key()
+            first = int(first_token)
+            if self._overlap_ok():
+                self._defer_admit(
+                    [s], jnp.asarray([first], jnp.int32),
+                    np.asarray([slot], np.int32), [n],
+                )
+            else:
+                self.metrics.counter("admit_sync_sessions")
+                self._finish_prefill(
+                    s, first, np.asarray(prompt, np.int32),
+                    self._ext_produced, n,
+                )
+            return s.generation_id
 
     # -- scheduling internals -------------------------------------------------
 

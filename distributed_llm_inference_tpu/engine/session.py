@@ -60,6 +60,11 @@ class Session:
     # When the prefill was dispatched (overlap path) — the admit-to-merge
     # latency observed at resolve time is ``resolve_t - prefill_dispatch_t``.
     prefill_dispatch_t: Optional[float] = None
+    # Admitted via engine.admit_prefilled (disaggregated serving): the
+    # prompt's KV was prefilled on a remote pool and imported here, so TTFT
+    # accounting splits into prefill-side (gateway-observed) and
+    # decode-side (this session's submit→first-token) components.
+    disagg: bool = False
     # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

@@ -13,7 +13,14 @@ deadlines that cancel the underlying generation, graceful SIGTERM drain,
 "HTTP serving" section for the curl quickstart.
 """
 
-from .backends import Backend, ClientBackend, EngineBackend, Handle, TokenEvent
+from .backends import (
+    Backend,
+    ClientBackend,
+    DisaggBackend,
+    EngineBackend,
+    Handle,
+    TokenEvent,
+)
 from .breaker import CircuitBreaker
 from .server import ApiServer
 
@@ -22,6 +29,7 @@ __all__ = [
     "Backend",
     "CircuitBreaker",
     "ClientBackend",
+    "DisaggBackend",
     "EngineBackend",
     "Handle",
     "TokenEvent",

@@ -20,13 +20,18 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import queue
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence
 
+from ..config import DisaggConfig
 from ..engine.sampling import SamplingOptions
 from ..utils.metrics import Metrics
+
+logger = logging.getLogger("distributed_llm_inference_tpu")
 
 
 @dataclasses.dataclass
@@ -146,7 +151,15 @@ class EngineBackend(Backend):
                         # quantity overlapped admission shrinks — from the
                         # gateway's wall-clock ``ttft`` (which adds HTTP
                         # queueing/fan-out time). Both ride /metrics.
-                        self.metrics.observe("engine_ttft", s.ttft)
+                        # Disaggregated sessions split the measurement: the
+                        # decode-side engine only sees admit → first token
+                        # (DisaggBackend observes the prefill side as
+                        # ``engine_ttft_prefill``), so folding it into
+                        # ``engine_ttft`` would skew the colocated summary.
+                        name = ("engine_ttft_decode"
+                                if getattr(s, "disagg", False)
+                                else "engine_ttft")
+                        self.metrics.observe(name, s.ttft)
                 ev = TokenEvent(token, finished, reason)
                 try:
                     self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)
@@ -185,6 +198,236 @@ class EngineBackend(Backend):
         self._unpaused.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+
+
+class _TransferAborted(Exception):
+    """KV shipment interrupted by cancel/stop — terminal, no fallback."""
+
+
+class DisaggBackend(EngineBackend):
+    """Disaggregated prefill/decode gateway backend.
+
+    The wrapped engine is this gateway's DECODE-pool member; it never runs
+    prompt prefill on the happy path. ``submit`` instead ships the prompt
+    to a ``role="prefill"`` node discovered through the block directory,
+    collects the prefilled KV planes back over the relay as
+    :mod:`..disagg.kv_codec` frames, and imports them with
+    ``engine.admit_prefilled`` — the session enters decode directly, with
+    the first token already sampled on the prefill side.
+
+    Every failure along that path — no prefill node registered, transfer
+    timeout, dropped/duplicated/corrupt frames, hash-chain mismatch,
+    decode-pool capacity — degrades to plain local prefill
+    (``engine.submit``) when :class:`~..config.DisaggConfig` has
+    ``fallback_local`` set (counted as ``disagg_fallback_local``), and to
+    a terminal error event otherwise. A chaos fault on the KV path must
+    slow a request down, never wedge it.
+    """
+
+    def __init__(
+        self,
+        engine,
+        relay_port: int,
+        relay_host: str = "127.0.0.1",
+        disagg_cfg: Optional[DisaggConfig] = None,
+        idle_sleep_s: float = 0.002,
+    ):
+        super().__init__(engine, idle_sleep_s=idle_sleep_s)
+        self.relay_host, self.relay_port = relay_host, relay_port
+        self.dcfg = disagg_cfg or DisaggConfig()
+        self._tlock = threading.Lock()
+        self._transfers: Dict[str, threading.Thread] = {}
+
+    def submit(self, prompt, options, deadline) -> Handle:
+        # The engine gen_id doesn't exist until the KV lands; hand the
+        # server a provisional handle and rebind it at admission. ``stop``
+        # doubles as the cancel signal for the transfer window, when the
+        # engine doesn't know the session yet.
+        key = f"disagg-{uuid.uuid4().hex[:12]}"
+        h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event())
+        t = threading.Thread(
+            target=self._run_disagg,
+            args=(h, key, list(prompt), options, deadline),
+            name=key, daemon=True,
+        )
+        with self._tlock:
+            self._transfers[key] = t
+        t.start()
+        return h
+
+    def cancel(self, handle: Handle) -> None:
+        if handle.stop is not None:
+            handle.stop.set()
+        # No-op while gen_id is still provisional; the transfer thread
+        # re-checks stop after registration, so the cancel can't slip
+        # between the two.
+        self.engine.cancel(handle.gen_id)
+
+    def queue_depth(self) -> int:
+        with self._tlock:
+            inflight = len(self._transfers)
+        # In-flight KV shipments are queued work the engine can't see yet —
+        # admission control must count them or a burst overshoots.
+        return self.engine.queue_depth() + inflight
+
+    # -- admission path ----------------------------------------------------
+
+    def _pick_prefill_node(self) -> Optional[dict]:
+        from ..distributed.directory import DirectoryClient
+
+        with DirectoryClient(self.relay_port, self.relay_host) as d:
+            nodes = [
+                n for n in d.alive()
+                if n.get("role") == "prefill" and not n.get("pending")
+            ]
+        if not nodes:
+            return None
+        return min(nodes, key=lambda n: n.get("load", 0))
+
+    def _fetch_kv(self, node, prompt, options, deadline, stop):
+        """Ship ``prompt`` to ``node``; return the decoded ``(planes,
+        meta)``. Raises on any transport or integrity failure (the caller
+        falls back), :class:`_TransferAborted` on cancel/stop."""
+        from ..cache.paged import PageAllocator
+        from ..disagg.kv_codec import _unpack, decode_kv
+        from ..distributed.messages import pack_frame
+        from ..distributed.relay import RelayClient
+
+        reply = f"disagg.kv.{uuid.uuid4().hex[:12]}"
+        budget = time.monotonic() + self.dcfg.transfer_timeout_s
+        if deadline is not None:
+            budget = min(budget, deadline)
+        frames: List[bytes] = []
+        total: Optional[int] = None
+        nbytes = 0
+        t0 = time.monotonic()
+        # A fresh RelayClient per transfer: the client is not thread-safe,
+        # and concurrent requests must not serialize on one socket.
+        client = RelayClient(self.relay_host, self.relay_port)
+        try:
+            client.put(node["queue"], pack_frame({
+                "op": "prefill", "gen": reply, "reply": reply,
+                "prompt": prompt,
+                "options": dataclasses.asdict(options),
+                "max_frame_bytes": self.dcfg.kv_frame_bytes,
+            }))
+            while total is None or len(frames) < total:
+                now = time.monotonic()
+                if now >= budget:
+                    raise TimeoutError(
+                        f"kv transfer timed out ({len(frames)} of "
+                        f"{total if total is not None else '?'} frames)"
+                    )
+                if stop.is_set() or self._stop_evt.is_set():
+                    raise _TransferAborted()
+                try:
+                    frame = client.get(reply, timeout=min(0.5, budget - now))
+                except TimeoutError:
+                    continue
+                header, _ = _unpack(frame)
+                if "error" in header:
+                    raise RuntimeError(
+                        f"prefill node error: {header['error']}"
+                    )
+                total = int(header["n"])
+                frames.append(frame)
+                nbytes += len(frame)
+        finally:
+            client.close()
+        planes, meta = decode_kv(frames)
+        if planes is None:  # pragma: no cover - error frames raise above
+            raise RuntimeError(f"prefill node error: {meta.get('error')}")
+        if meta["chain"] and meta.get("ps"):
+            # The prompt hash chain rides the transfer end-to-end: a
+            # mismatch means the planes answer a DIFFERENT prompt (stale
+            # reply-queue reuse, worker bug) — reject before import.
+            expect = PageAllocator.chain_keys(prompt, meta["ps"])
+            if list(meta["chain"]) != list(expect):
+                raise ValueError("kv transfer prompt hash-chain mismatch")
+        self.metrics.observe("kv_transfer_bytes", float(nbytes))
+        self.metrics.observe(
+            "kv_transfer_ms", (time.monotonic() - t0) * 1e3
+        )
+        return planes, meta
+
+    def _run_disagg(self, h, key, prompt, options, deadline) -> None:
+        t0 = time.monotonic()
+        gid: Optional[str] = None
+        fail: Optional[str] = None
+        try:
+            try:
+                node = self._pick_prefill_node()
+                # Optional grace for an empty pool (rolling restart of the
+                # prefill tier): poll until a node appears or the grace
+                # lapses, then fall back rather than queue indefinitely.
+                wait_until = t0 + self.dcfg.prefill_wait_s
+                while (node is None and time.monotonic() < wait_until
+                       and not h.stop.is_set()
+                       and not self._stop_evt.is_set()):
+                    time.sleep(0.1)
+                    node = self._pick_prefill_node()
+                if node is None:
+                    raise LookupError("no prefill node registered")
+                planes, meta = self._fetch_kv(
+                    node, prompt, options, deadline, h.stop
+                )
+                with self._hlock:
+                    gid = self.engine.admit_prefilled(
+                        prompt, planes, meta["first_token"],
+                        options=options, deadline=deadline,
+                    )
+                    if gid is not None:
+                        h.gen_id = gid
+                        self._handles[gid] = h
+                if gid is None:
+                    raise RuntimeError("decode pool at capacity")
+                # Prefill-side TTFT: request arrival → KV imported with the
+                # first token in hand (pairs with ``engine_ttft_decode``).
+                self.metrics.observe(
+                    "engine_ttft_prefill", time.monotonic() - t0
+                )
+            except _TransferAborted:
+                fail = "cancelled"
+            except Exception as e:  # noqa: BLE001 - degrade, never wedge
+                if not self.dcfg.fallback_local:
+                    fail = f"error: {type(e).__name__}"
+                else:
+                    logger.warning(
+                        "disagg admission failed (%r); prefilling locally", e
+                    )
+                    self.metrics.counter("disagg_fallback_local")
+                    try:
+                        with self._hlock:
+                            gid = self.engine.submit(
+                                prompt, options, deadline=deadline
+                            )
+                            h.gen_id = gid
+                            self._handles[gid] = h
+                    except Exception as e2:  # noqa: BLE001
+                        fail = f"error: {type(e2).__name__}"
+            if gid is not None and h.stop.is_set():
+                self.engine.cancel(gid)  # cancel raced the registration
+        finally:
+            with self._tlock:
+                self._transfers.pop(key, None)
+            if fail is not None and self._loop is not None:
+                # The stream never reached the engine: it still owes its
+                # consumer a terminal event or the gateway handler hangs.
+                try:
+                    self._loop.call_soon_threadsafe(
+                        h.queue.put_nowait, TokenEvent(-1, True, fail)
+                    )
+                except RuntimeError:
+                    pass  # loop already closed
+
+    def stop(self, timeout: float = 10.0) -> None:
+        end = time.monotonic() + timeout
+        self._stop_evt.set()  # aborts in-flight transfers at the next poll
+        with self._tlock:
+            transfers = list(self._transfers.values())
+        for t in transfers:
+            t.join(timeout=max(0.0, end - time.monotonic()))
+        super().stop(timeout=max(0.0, end - time.monotonic()))
 
 
 class ClientBackend(Backend):

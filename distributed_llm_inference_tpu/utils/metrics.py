@@ -123,7 +123,10 @@ class Metrics:
             vals = sorted(timings[name])
             if not vals:
                 continue
-            metric = clean(name) + "_seconds"
+            # Summaries default to seconds; names that already carry their
+            # unit (kv_transfer_bytes, kv_transfer_ms) keep it as-is.
+            suffix = "" if name.endswith(("_bytes", "_ms")) else "_seconds"
+            metric = clean(name) + suffix
             lines.append(f"# TYPE {metric} summary")
             p50 = vals[len(vals) // 2]
             p99 = vals[min(len(vals) - 1, int(0.99 * len(vals)))]

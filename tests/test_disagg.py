@@ -1,0 +1,487 @@
+"""Disaggregated prefill/decode suite.
+
+Covers the KV-shipping subsystem end to end:
+
+* codec round trips — bf16 and int8+scales planes survive
+  ``encode_kv``/``decode_kv`` byte-exact, including ragged ``n_valid``
+  and out-of-order frames; every integrity violation (drop, duplicate,
+  corruption, header skew) raises instead of importing garbage;
+* the allocator / directory satellites — ``PageAllocator.free``
+  validates its whole argument before mutating, ``register`` retires
+  pending reservations, roles filter layer routes;
+* engine parity — a session prefilled on one engine, shipped through
+  the codec, and imported with ``admit_prefilled`` on another produces
+  the BYTE-EXACT token stream local ``generate`` would have (greedy and
+  sampled, dense and paged, f32 and int8 KV), solo-vs-solo so scheduling
+  never perturbs RNG key order;
+* the gateway — ``DisaggBackend`` over a real relay + prefill worker
+  matches local streams, and chaos faults on the KV path (drop,
+  corrupt) degrade to local-prefill fallback without hanging.
+"""
+
+import asyncio
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.cache.paged import PageAllocator
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    DisaggConfig,
+    EngineConfig,
+    ModelConfig,
+)
+from distributed_llm_inference_tpu.disagg import PrefillWorker
+from distributed_llm_inference_tpu.disagg.kv_codec import (
+    decode_kv,
+    encode_error,
+    encode_kv,
+)
+from distributed_llm_inference_tpu.distributed.directory import (
+    BlockDirectory,
+    DirectoryService,
+)
+from distributed_llm_inference_tpu.distributed.relay import (
+    RelayServer,
+    native_available,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.serving import DisaggBackend
+
+pytestmark = pytest.mark.disagg
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ unavailable to build the native relay"
+)
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_engine(kind="paged", kv_quant=None, batch=2, prefix_caching=False):
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=batch, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind=kind, kv_quant=kv_quant, page_size=8, num_pages=64,
+                    max_pages_per_session=8, prefix_caching=prefix_caching),
+    )
+
+
+def drain(engine, gid, budget_s=60.0):
+    """Step the engine until ``gid`` finishes; return its token stream."""
+    toks = []
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        for g, tok, fin in engine.step():
+            if g != gid:
+                continue
+            if tok >= 0:
+                toks.append(tok)
+            if fin:
+                engine.collect_finished()
+                return toks
+        engine.collect_finished()
+    raise AssertionError(f"{gid} did not finish within {budget_s}s")
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def _mk_planes(quant=False, s=13, seed=0):
+    rng = np.random.default_rng(seed)
+    layers, heads, dim = 2, 2, 16
+    if quant:
+        return {
+            "k": rng.integers(-127, 128, (layers, s, heads, dim),
+                              dtype=np.int8),
+            "v": rng.integers(-127, 128, (layers, s, heads, dim),
+                              dtype=np.int8),
+            "ks": rng.random((layers, s, heads), dtype=np.float32),
+            "vs": rng.random((layers, s, heads), dtype=np.float32),
+        }
+    import ml_dtypes
+
+    return {
+        "k": rng.standard_normal((layers, s, heads, dim)).astype(
+            ml_dtypes.bfloat16
+        ),
+        "v": rng.standard_normal((layers, s, heads, dim)).astype(
+            ml_dtypes.bfloat16
+        ),
+    }
+
+
+def _assert_planes_equal(a, b):
+    assert set(a) == set(b)
+    for name in a:
+        x, y = np.asarray(a[name]), np.asarray(b[name])
+        assert x.dtype == y.dtype and x.shape == y.shape, name
+        # bf16 compares as raw bits: byte-exact is the contract.
+        if x.dtype.name == "bfloat16":
+            x, y = x.view(np.uint16), y.view(np.uint16)
+        np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("s", [13, 16])  # ragged and page-aligned n_valid
+def test_codec_roundtrip_byte_exact(quant, s):
+    planes = _mk_planes(quant, s)
+    chain = [bytes(range(20)), bytes(range(20, 40))]
+    frames = encode_kv("g1", planes, s, first_token=42, chain=chain,
+                       page_size=8, quant=quant, max_frame_bytes=1024)
+    assert len(frames) > 1  # the split actually exercised reassembly
+    # Arrival order must not matter: headers carry the index.
+    out, meta = decode_kv(list(reversed(frames)))
+    _assert_planes_equal(planes, out)
+    assert meta["n_valid"] == s
+    assert meta["first_token"] == 42
+    assert meta["quant"] is quant
+    assert meta["chain"] == chain
+    assert meta["ps"] == 8
+    assert meta["gens"] == ["g1"]
+
+
+def test_codec_error_frame():
+    frame = encode_error("g2", "ValueError('boom')")
+    planes, meta = decode_kv([frame])
+    assert planes is None
+    assert "boom" in meta["error"]
+
+
+def test_codec_rejects_tampering():
+    planes = _mk_planes(s=9)
+    frames = encode_kv("g3", planes, 9, 7, max_frame_bytes=512)
+    assert len(frames) >= 3
+    with pytest.raises(ValueError, match="missing"):
+        decode_kv(frames[:-1])  # dropped frame
+    with pytest.raises(ValueError, match="duplicate"):
+        decode_kv(frames + [frames[0]])
+    with pytest.raises(ValueError):
+        decode_kv([])  # empty transfer
+    # Flip one payload byte (past the longest header): CRC must catch it.
+    corrupt = bytearray(frames[1])
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC|length"):
+        decode_kv([frames[0], bytes(corrupt)] + frames[2:])
+    # Splice a frame from a different transfer: header consistency check.
+    other = encode_kv("g4", planes, 9, 8, max_frame_bytes=512)
+    with pytest.raises(ValueError, match="disagree|duplicate"):
+        decode_kv(frames[:-1] + [other[-1]])
+
+
+# -- allocator satellite ------------------------------------------------------
+
+
+def test_free_validates_whole_list_before_mutating():
+    a = PageAllocator(8)  # page 0 reserved → 7 usable
+    pages = a.alloc(3)
+    assert a.free_count == 4
+    with pytest.raises(ValueError, match="outside"):
+        a.free(pages + [0])  # null page invalid → NOTHING released
+    assert a.free_count == 4
+    with pytest.raises(ValueError, match="double free"):
+        a.free([pages[0], pages[0]])  # dup within one call over-releases
+    assert a.free_count == 4
+    a.free(pages)
+    assert a.free_count == 7
+    with pytest.raises(ValueError, match="double free"):
+        a.free([pages[0]])
+    assert a.free_count == 7
+
+
+# -- directory satellites -----------------------------------------------------
+
+
+def test_register_retires_pending_reservation():
+    d = BlockDirectory(default_ttl=5.0)
+    first, last = d.assign(4, reserve_ttl=5.0)
+    assert (first, last) == (0, 3)
+    assert [n.pending for n in d.alive()] == [True]
+    d.register("n1", first, last, "block.n1")
+    nodes = d.alive()
+    assert [n.node_id for n in nodes] == ["n1"]
+    assert not nodes[0].pending  # reservation retired immediately, not TTL'd
+
+
+def test_register_retires_only_its_own_reservation():
+    d = BlockDirectory(default_ttl=5.0)
+    a = d.assign(4, span=2, reserve_ttl=5.0)  # (0, 1)
+    b = d.assign(4, span=2, reserve_ttl=5.0)  # (2, 3): sees a's reservation
+    assert a == (0, 1) and b == (2, 3)
+    d.register("n-b", 2, 3, "block.b")
+    kept = [n for n in d.alive() if n.pending]
+    assert len(kept) == 1 and (kept[0].first_layer, kept[0].last_layer) == a
+    d.register("n-a", 0, 1, "block.a")
+    assert not any(n.pending for n in d.alive())
+    assert [n.node_id for n in d.plan_route(4)] == ["n-a", "n-b"]
+
+
+def test_reservation_expires_without_register():
+    d = BlockDirectory(default_ttl=5.0)
+    d.assign(4, reserve_ttl=0.15)
+    assert len(d.alive()) == 1
+    with pytest.raises(LookupError):
+        d.plan_route(4)  # pending never routes
+    time.sleep(0.25)
+    assert d.alive() == []  # lapsed reservation re-opens the range
+    assert d.assign(4) == (0, 3)
+
+
+def test_concurrent_join_reservations_spread_and_retire():
+    d = BlockDirectory(default_ttl=5.0)
+    errs = []
+
+    def join():
+        try:
+            first, last = d.assign(8, span=2, reserve_ttl=5.0)
+            time.sleep(0.01)  # simulated weight-load latency
+            d.register(f"n{first}", first, last, f"block.n{first}")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=join) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    nodes = d.alive()
+    assert not any(n.pending for n in nodes)  # every reservation retired
+    assert len(nodes) == 4
+    # Reservations steered the concurrent joiners to disjoint ranges.
+    assert len(d.plan_route(8)) == 4
+
+
+def test_prefill_role_excluded_from_routes():
+    d = BlockDirectory(default_ttl=5.0)
+    d.register("pf", 0, 3, "prefill.pf", role="prefill")
+    with pytest.raises(LookupError):
+        d.plan_route(4)
+    d.register("w", 0, 3, "block.w", role="both")
+    assert [n.node_id for n in d.plan_route(4)] == ["w"]
+    assert {n.node_id: n.role for n in d.alive()} == {
+        "pf": "prefill", "w": "both",
+    }
+    with pytest.raises(ValueError, match="role"):
+        d.register("x", 0, 3, "q", role="bogus")
+
+
+# -- engine parity ------------------------------------------------------------
+
+
+def _ship(src, dst, prompt, opts, max_frame_bytes=2048):
+    """prefill_export on ``src`` → codec → admit_prefilled on ``dst``."""
+    planes, first, chain = src.prefill_export(prompt, opts)
+    frames = encode_kv("ship", planes, len(prompt), first, chain,
+                       page_size=src.ccfg.page_size, quant="ks" in planes,
+                       max_frame_bytes=max_frame_bytes)
+    dec, meta = decode_kv(frames)
+    gid = dst.admit_prefilled(prompt, dec, meta["first_token"], options=opts)
+    assert gid is not None
+    return gid
+
+
+@pytest.mark.parametrize("kind,kv_quant,temp", [
+    ("paged", None, 0.0),
+    ("paged", "int8", 0.8),
+    ("dense", None, 0.8),
+    ("dense", "int8", 0.0),
+])
+def test_disagg_stream_byte_exact(kind, kv_quant, temp):
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+    opts = SamplingOptions(temperature=temp, max_new_tokens=6)
+    # Solo-vs-solo: both sides consume PRNG keys in sequential-session
+    # order, so even sampled streams must match byte-for-byte.
+    base = make_engine(kind, kv_quant).generate([prompt], opts)[0]
+    src, dst = make_engine(kind, kv_quant), make_engine(kind, kv_quant)
+    gid = _ship(src, dst, prompt, opts)
+    assert drain(dst, gid) == base
+
+
+def test_disagg_seeds_prefix_cache():
+    prompt = list(range(1, 18))  # two full 8-token pages + ragged tail
+    opts = SamplingOptions(max_new_tokens=4)
+    src = make_engine("paged", prefix_caching=True)
+    dst = make_engine("paged", prefix_caching=True)
+    gid = _ship(src, dst, prompt, opts)
+    drain(dst, gid)
+    # The imported prompt registered its full-prefix pages: a later local
+    # session with the same prompt prefix hits the cache.
+    keys = PageAllocator.chain_keys(prompt, dst.ccfg.page_size)
+    assert keys and all(k in dst.allocator._registry for k in keys)
+
+
+def test_disagg_rejects_mismatched_quantization():
+    prompt = [1, 2, 3, 4, 5]
+    opts = SamplingOptions(max_new_tokens=4)
+    planes, first, _ = make_engine("paged", "int8").prefill_export(
+        prompt, opts
+    )
+    with pytest.raises(ValueError, match="quant"):
+        make_engine("paged").admit_prefilled(prompt, planes, first,
+                                             options=opts)
+
+
+def test_disagg_rejects_sink_cache():
+    prompt = [1, 2, 3, 4, 5]
+    opts = SamplingOptions(max_new_tokens=4)
+    eng = make_engine("sink")
+    with pytest.raises(ValueError):
+        eng.prefill_export(prompt, opts)
+    planes, first, _ = make_engine("dense").prefill_export(prompt, opts)
+    with pytest.raises(ValueError):
+        eng.admit_prefilled(prompt, planes, first, options=opts)
+
+
+def test_disagg_admit_overlaps_inflight_decode():
+    """admit_prefilled lands on the PR-4 deferred path when a decode tick
+    is in flight — and the stream is still byte-exact."""
+    prompt = [2, 7, 1, 8, 2, 8]
+    bg = [9, 8, 7, 6, 5]
+    opts = SamplingOptions(max_new_tokens=8)
+    base = make_engine("dense").generate([prompt], opts)[0]
+    src, dst = make_engine("dense"), make_engine("dense", batch=4)
+    if not dst._pipelined:
+        pytest.skip("overlap admission needs the pipelined decode path")
+    bg_gid = dst.submit(bg, SamplingOptions(max_new_tokens=48))
+    for _ in range(6):  # admit bg, then leave a decode dispatch in flight
+        dst.step()
+        if dst._pending is not None:
+            break
+    assert dst._pending is not None
+    gid = _ship(src, dst, prompt, opts)
+    assert dst._inflight_admits  # took the deferred (overlapped) path
+    events = {}
+    deadline = time.monotonic() + 60
+    while len(events.get(gid, [])) < 1 or not events.get("done"):
+        assert time.monotonic() < deadline
+        for g, tok, fin in dst.step():
+            if tok >= 0:
+                events.setdefault(g, []).append(tok)
+            if fin and g == gid:
+                events["done"] = True
+        dst.collect_finished()
+    assert events[gid] == base
+    assert len(events[bg_gid]) >= 1  # background session kept streaming
+
+
+# -- gateway ------------------------------------------------------------------
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    yield loop
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def _gateway_stream(backend, loop, prompt, opts, timeout=30.0):
+    h = backend.submit(prompt, opts, deadline=time.monotonic() + timeout)
+
+    async def _drain():
+        toks = []
+        while True:
+            ev = await asyncio.wait_for(h.queue.get(), timeout=timeout)
+            if ev.token >= 0:
+                toks.append(ev.token)
+            if ev.finished:
+                return toks, ev.finish_reason
+
+    return asyncio.run_coroutine_threadsafe(_drain(), loop).result(
+        timeout=timeout + 30
+    )
+
+
+@needs_native
+def test_gateway_disagg_parity_then_fallback(loop):
+    prompt = [1, 2, 3, 4, 5]
+    opts = SamplingOptions(max_new_tokens=6)
+    base = make_engine().generate([prompt], opts)[0]
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=5.0):
+            worker = PrefillWorker(relay.port, make_engine())
+            backend = DisaggBackend(
+                make_engine(), relay.port,
+                disagg_cfg=DisaggConfig(transfer_timeout_s=10.0),
+            )
+            backend.start(loop)
+            try:
+                toks, reason = _gateway_stream(backend, loop, prompt, opts)
+                assert toks == base and reason == "length"
+                snap = backend.metrics.prometheus()
+                assert "dli_kv_transfer_bytes" in snap
+                assert "dli_kv_transfer_ms" in snap
+                assert "dli_engine_ttft_prefill_seconds" in snap
+                assert "dli_engine_ttft_decode_seconds" in snap
+                assert backend.metrics.get_counter(
+                    "disagg_fallback_local") == 0
+                # Prefill pool gone: the SAME request must still stream,
+                # via local prefill.
+                worker.stop()
+                toks, reason = _gateway_stream(backend, loop, prompt, opts)
+                assert toks == base and reason == "length"
+                assert backend.metrics.get_counter(
+                    "disagg_fallback_local") == 1
+            finally:
+                backend.stop()
+                if worker.is_healthy():
+                    worker.stop()
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.parametrize("spec", [
+    "drop:disagg.kv.*:put",
+    "corrupt:disagg.kv.*:put",
+])
+def test_gateway_falls_back_under_kv_faults(loop, spec):
+    """Chaos on the KV transfer path (frames dropped or corrupted in
+    flight) must degrade to local prefill — same tokens, no hang."""
+    from distributed_llm_inference_tpu.distributed.chaos import (
+        ChaosProxy,
+        FaultPlan,
+    )
+
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    opts = SamplingOptions(max_new_tokens=4)
+    base = make_engine().generate([prompt], opts)[0]
+    plan = FaultPlan.from_specs([spec], seed=42)
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=5.0):
+            with ChaosProxy("127.0.0.1", relay.port, plan=plan) as proxy:
+                # The worker ships its KV through the chaos proxy; the
+                # gateway talks to the clean relay.
+                worker = PrefillWorker(proxy.port, make_engine())
+                backend = DisaggBackend(
+                    make_engine(), relay.port,
+                    disagg_cfg=DisaggConfig(transfer_timeout_s=3.0),
+                )
+                backend.start(loop)
+                try:
+                    t0 = time.monotonic()
+                    toks, reason = _gateway_stream(
+                        backend, loop, prompt, opts, timeout=30.0
+                    )
+                    assert toks == base and reason == "length"
+                    assert backend.metrics.get_counter(
+                        "disagg_fallback_local") == 1
+                    assert plan.injected, f"fault {spec} never fired"
+                    # Degraded, not wedged: bounded by the transfer
+                    # timeout, nowhere near the request deadline.
+                    assert time.monotonic() - t0 < 25.0
+                finally:
+                    backend.stop()
+                    worker.stop()

@@ -16,6 +16,15 @@
 # greedy drain, single-deadline linger, eager stacked frames, deferred
 # fairness) is tier-1 too — gate-based, no device, collected by tests/.
 #
+# The disaggregated prefill/decode suite (tests/test_disagg.py, marked
+# 'disagg': codec round trips, KV-shipping parity, gateway fallback
+# under chaos) rides tier-1 the same way — none of it is 'slow', and the
+# byte-exact disagg-vs-local parity cases are the correctness gate for
+# admit_prefilled. conftest.py schedules the disagg block after all
+# other modules so the 870 s budget below covers the long-standing
+# suites in their historical order first (the full suite outlasts the
+# cap; an uncapped `pytest tests/` covers everything).
+#
 # The admission-overlap contract tests (tests/test_engine.py, the
 # "overlapped (stall-free) admission" section: byte-exact parity with
 # overlap_admission on/off, cancel/deadline-during-inflight-prefill,
