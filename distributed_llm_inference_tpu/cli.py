@@ -18,6 +18,9 @@ Subcommands map onto the deployment roles:
 * ``chaos``     fault-injecting TCP proxy in front of a relay hub: point
                 endpoints at its port and replay a seeded failure schedule
 * ``info``      inspect a checkpoint (config, layer count, shard files)
+* ``check``     run the ``tools.distcheck`` static analyzer over the
+                package (lock discipline, event-loop lints, PRNG/host-sync
+                hygiene, metrics registry, relay-frame schema)
 
 Examples::
 
@@ -38,6 +41,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import time
@@ -512,6 +516,27 @@ def cmd_info(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    # tools/ lives at the repo root, one level above this package; when
+    # running from an installed copy without tools/ the gate cannot run,
+    # so say so instead of crashing.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isfile(os.path.join(
+            repo_root, "tools", "distcheck", "core.py")):
+        print("distribute check: tools/distcheck not found "
+              f"(looked under {repo_root}); run from a source checkout",
+              file=sys.stderr)
+        return 2
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.distcheck.__main__ import main as distcheck_main
+
+    argv = list(args.paths)
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    return distcheck_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="distribute",
@@ -728,6 +753,18 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("info", help="inspect a checkpoint")
     i.add_argument("--model", required=True)
     i.set_defaults(fn=cmd_info)
+
+    k = sub.add_parser(
+        "check",
+        help="run the distcheck static analyzer (lock discipline, "
+             "event-loop lints, PRNG/host-sync hygiene, metrics registry, "
+             "frame schema)")
+    k.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to analyze (default: the "
+                        "installed package)")
+    k.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    k.set_defaults(fn=cmd_check)
     return p
 
 

@@ -67,7 +67,11 @@ class PrefillWorker:
         self.lease_ttl = lease_ttl
         self.metrics = engine.metrics
         self._stop = threading.Event()
-        self._busy = 0  # directory load hint (heartbeat thread reads it)
+        # Directory load hint: the consume thread counts in-flight prefills,
+        # the heartbeat thread reports them — cross-thread, so locked
+        # (distcheck DC101: unguarded += here raced the heartbeat read).
+        self._busy_lock = threading.Lock()
+        self._busy = 0
         # Register FIRST (mirrors ServingNode): a directory/relay failure
         # here must not leak threads or sockets.
         self._directory = DirectoryClient(relay_port, host)
@@ -120,11 +124,13 @@ class PrefillWorker:
                 reply = header.get("reply")
                 if not reply:
                     continue  # nowhere to answer — drop
-                self._busy += 1
+                with self._busy_lock:
+                    self._busy += 1
                 try:
                     self._handle(header, reply)
                 finally:
-                    self._busy -= 1
+                    with self._busy_lock:
+                        self._busy -= 1
         finally:
             client.close()
 
@@ -164,8 +170,10 @@ class PrefillWorker:
     def _health_loop(self) -> None:
         while not self._stop.wait(self.dcfg.heartbeat_s):
             try:
+                with self._busy_lock:
+                    load = self._busy
                 alive = self._directory.heartbeat(
-                    self.node_id, load=self._busy, ttl=self.lease_ttl
+                    self.node_id, load=load, ttl=self.lease_ttl
                 )
                 if not alive:  # lease lapsed (e.g. directory restart)
                     self._register()

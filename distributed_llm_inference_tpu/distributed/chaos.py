@@ -180,6 +180,9 @@ class _Pipe:
         # RelayClient is strictly serial (one outstanding GET/PING per
         # connection), so the queue of the last request is enough to
         # attribute the next reply frame.
+        # c2s writes it, s2c reads it; the relay protocol is strictly
+        # serial per pipe (one in-flight op), so the phases never overlap.
+        # distcheck: unguarded-ok(protocol is strictly serial per pipe)
         self.last_tag = "*"
         for name, fn in (("c2s", self._c2s), ("s2c", self._s2c)):
             t = threading.Thread(
@@ -326,6 +329,7 @@ class ChaosProxy:
         self.plan = plan
         self._pipes: List[_Pipe] = []
         self._plock = threading.Lock()
+        # distcheck: unguarded-ok(atomic flag; accept loop tolerates stale)
         self._closed = False
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

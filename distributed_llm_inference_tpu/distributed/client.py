@@ -321,7 +321,11 @@ class DistributedClient:
                 if isinstance(e, WorkerError) and not e.retryable:
                     raise  # deterministic worker error: replay cannot help
                 failures += 1
-                self.failovers += 1
+                # Concurrent generate()/generate_many() callers land here
+                # together after a relay restart; unguarded += lost counts
+                # (distcheck DC103).
+                with self._conn_lock:
+                    self.failovers += 1
                 self.metrics.counter("failovers")
                 if failures > max_retries:
                     raise
@@ -503,7 +507,11 @@ class DistributedClient:
                 if isinstance(e, WorkerError) and not e.retryable:
                     raise
                 failures += 1
-                self.failovers += 1
+                # Concurrent generate()/generate_many() callers land here
+                # together after a relay restart; unguarded += lost counts
+                # (distcheck DC103).
+                with self._conn_lock:
+                    self.failovers += 1
                 self.metrics.counter("failovers")
                 if failures > max_retries:
                     raise

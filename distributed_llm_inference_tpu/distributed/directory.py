@@ -291,6 +291,7 @@ class DirectoryClient:
     def __init__(self, relay_port: int, host: str = "127.0.0.1"):
         self._client = RelayClient(host, relay_port)
         self._reply_queue = f"directory.reply.{uuid.uuid4().hex}"
+        # distcheck: unguarded-ok(client contract: externally serialized)
         self._seq = 0
 
     def _call(self, req: dict, timeout: float = 5.0) -> dict:

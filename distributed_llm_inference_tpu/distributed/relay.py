@@ -123,7 +123,11 @@ class RelayClient:
         self.reconnect_timeout_s = reconnect_timeout_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
+        # distcheck: unguarded-ok(one client = one consumer thread)
         self.reconnects = 0  # successful re-dials (observability)
+        # close() flips this from any thread while _reconnect polls it;
+        # a bool store is atomic and one stale read only costs one dial.
+        # distcheck: unguarded-ok(atomic flag; stale read is benign)
         self._closed = False
         self._sock: Optional[socket.socket] = None
         self._connect()

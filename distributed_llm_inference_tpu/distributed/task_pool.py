@@ -30,10 +30,26 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, List, Optional, Tuple
 
 __all__ = ["TaskPool"]
+
+
+def _complete(fut: Future, result=None, exc: Optional[BaseException] = None):
+    """Resolve a future exactly once: stop() failing leftovers can race
+    _run() delivering real results (when the join timed out on a wedged
+    fn) — the slower writer must lose quietly, not raise InvalidStateError
+    out of stop()."""
+    if fut.done():
+        return
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass
 
 
 class TaskPool:
@@ -62,9 +78,13 @@ class TaskPool:
         self.name = name
         self.metrics = metrics
         self._queue: "queue.Queue[Tuple[Any, Future, bool]]" = queue.Queue()
-        # Incompatible items parked during earlier rounds; owned by the loop
-        # thread, consumed before new arrivals (fairness).
-        self._deferred: List[Tuple[Any, Future, bool]] = []
+        # Incompatible items parked during earlier rounds, consumed before
+        # new arrivals (fairness). Normally loop-thread-only, but stop()
+        # drains it even when the join times out on a wedged fn — so every
+        # access is locked (distcheck DC101: the unguarded drain raced the
+        # loop thread's pop/append).
+        self._dlock = threading.Lock()
+        self._deferred: List[Tuple[Any, Future, bool]] = []  # distcheck: guarded-by(_dlock)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=name)
@@ -84,16 +104,22 @@ class TaskPool:
         return self.submit(item).result(timeout)
 
     def _take_deferred(self, sig) -> Optional[Tuple[Any, Future]]:
-        for i, item in enumerate(self._deferred):
-            if self.signature(item[0]) == sig:
-                return self._deferred.pop(i)
+        with self._dlock:
+            for i, item in enumerate(self._deferred):
+                if self.signature(item[0]) == sig:
+                    return self._deferred.pop(i)
+        return None
+
+    def _take_oldest(self) -> Optional[Tuple[Any, Future, bool]]:
+        with self._dlock:
+            if self._deferred:
+                return self._deferred.pop(0)  # oldest parked group first
         return None
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            if self._deferred:
-                first = self._deferred.pop(0)  # oldest parked group first
-            else:
+            first = self._take_oldest()
+            if first is None:
                 try:
                     first = self._queue.get(timeout=0.1)
                 except queue.Empty:
@@ -120,7 +146,8 @@ class TaskPool:
                         except queue.Empty:
                             break
                     if self.signature(item[0]) != sig:
-                        self._deferred.append(item)
+                        with self._dlock:
+                            self._deferred.append(item)
                         continue
                 eager = eager or item[2]
                 batch.append(item)
@@ -139,26 +166,29 @@ class TaskPool:
                     f"{len(items)} items"
                 )
             for entry, res in zip(batch, results):
-                entry[1].set_result(res)
+                _complete(entry[1], result=res)
         except Exception as e:
             for entry in batch:
-                if not entry[1].done():
-                    entry[1].set_exception(e)
+                _complete(entry[1], exc=e)
 
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
         # Fail anything still queued or parked so submitters don't hang.
-        leftovers = list(self._deferred)
-        self._deferred = []
+        # If the join above timed out (fn wedged on the device), the loop
+        # thread is still live — drain under the lock and complete futures
+        # race-safely rather than double-resolving what _run() just set.
+        with self._dlock:
+            leftovers = list(self._deferred)
+            self._deferred = []
         while True:
             try:
                 leftovers.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        err = RuntimeError(f"{self.name} stopped")
         for entry in leftovers:
-            if not entry[1].done():
-                entry[1].set_exception(RuntimeError(f"{self.name} stopped"))
+            _complete(entry[1], exc=err)
 
     def __enter__(self):
         return self

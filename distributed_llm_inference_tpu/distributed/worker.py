@@ -84,7 +84,11 @@ class ServingNode:
             cache_cfg=cache_cfg, mesh_cfg=mesh_cfg, **kw,
         )
         self._stop = threading.Event()
+        # Crash log: consume + pool threads append (GIL-atomic), tests read
+        # after join — no torn state to guard.
+        # distcheck: unguarded-ok(list.append is atomic; read after join)
         self.errors: List[str] = []
+        # distcheck: unguarded-ok(health thread is the only writer)
         self.restarts = 0
         self.metrics = Metrics()  # /metrics surface for chaos observability
         # Highest hop seq applied per generation (pool thread only). An
@@ -125,6 +129,9 @@ class ServingNode:
             self._out.close()
             self._directory.close()
             raise
+        # Rebound by the health watchdog when a consumer dies; readers only
+        # probe .is_alive() on whichever generation they observe.
+        # distcheck: unguarded-ok(single rebinding writer; stale reads safe)
         self._consume_thread = self._spawn_consumer()
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True

@@ -2303,6 +2303,7 @@ class InferenceEngine:
                     self.params, jnp.asarray(tokens), self.cache,
                     jnp.asarray(active), self._next_key(), sp,
                 )
+                # distcheck: host-sync-ok(the one per-tick fetch for K=1)
                 emitted = np.asarray(jax.device_get(next_tokens))[None, :]
             else:
                 eos_ids = np.asarray(
@@ -2313,6 +2314,7 @@ class InferenceEngine:
                     jnp.asarray(active), self._next_key(), sp,
                     jnp.asarray(eos_ids), jnp.asarray(budget),
                 )
+                # distcheck: host-sync-ok(the one per-tick fetch for K>1)
                 emitted = np.asarray(jax.device_get(emitted))
 
         delivered = 0
@@ -2503,6 +2505,7 @@ class InferenceEngine:
         pack_d, active, spec, _pend, gids = prev
         k = self.ecfg.speculative_k
         with self.metrics.timer("decode_resolve"):
+            # distcheck: host-sync-ok(deferred-fetch: overlaps next dispatch)
             pack = np.asarray(jax.device_get(pack_d))  # [R, B, k+3]
         emits = pack[:, :, : k + 1]
         accs = pack[:, :, k + 1]
@@ -2622,8 +2625,11 @@ class InferenceEngine:
             )
         # Fetch the proposals AFTER dispatching verify: the copy overlaps
         # the target's k+1-position forward instead of serializing before it.
+        # distcheck: host-sync-ok(post-verify fetch overlaps the forward)
         prop = np.asarray(jax.device_get(prop_d)).T  # [B, k]
+        # distcheck: host-sync-ok(post-verify fetch overlaps the forward)
         preds = np.asarray(jax.device_get(preds_d))
+        # distcheck: host-sync-ok(post-verify fetch overlaps the forward)
         sampled = np.asarray(jax.device_get(sampled_d))
 
         rollback = np.zeros((b,), np.int32)

@@ -62,7 +62,7 @@ class CircuitBreaker:
 
     # -- state machine (callers hold self._lock) ------------------------------
 
-    def _set_state(self, state: str) -> None:
+    def _set_state(self, state: str) -> None:  # distcheck: holds-lock(_lock)
         if state == self._state:
             return
         self._state = state
@@ -76,7 +76,7 @@ class CircuitBreaker:
         else:  # CLOSED
             self._failures = 0
 
-    def _maybe_half_open(self) -> None:
+    def _maybe_half_open(self) -> None:  # distcheck: holds-lock(_lock)
         if (
             self._state == OPEN
             and self._clock() - self._opened_at >= self.recovery_s

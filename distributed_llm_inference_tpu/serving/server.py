@@ -85,6 +85,9 @@ class ApiServer:
         self._shutdown: Optional[asyncio.Event] = None
         self._thread: Optional[threading.Thread] = None
         self._draining = False
+        # Admission accounting is event-loop-confined: every += / -= runs
+        # on the server's own loop, never from another thread.
+        # distcheck: unguarded-ok(event-loop confined)
         self._inflight = 0
         self._handles: set = set()
 
@@ -134,7 +137,10 @@ class ApiServer:
         t0 = time.monotonic()
         while self._inflight > 0 and time.monotonic() - t0 < 2.0:
             await asyncio.sleep(0.01)
-        self.backend.stop()
+        # stop() joins driver/consume threads (up to their join timeouts);
+        # doing that on the loop would freeze the final drain responses
+        # still being flushed (distcheck DC200).
+        await loop.run_in_executor(None, self.backend.stop)
 
     def serve_forever(self, ready_cb=None) -> None:
         asyncio.run(self._main(ready_cb=ready_cb, install_signals=True))
@@ -252,11 +258,18 @@ class ApiServer:
         await writer.drain()
 
     async def _metrics(self, writer) -> None:
-        text = self.backend.metrics.prometheus(extra_gauges={
+        # prometheus() takes the metrics lock and sorts every timing
+        # series — under load that's milliseconds the accept loop and all
+        # live SSE streams would stall for (distcheck DC200). Gauges are
+        # sampled on the loop (cheap), the render runs in the executor.
+        gauges = {
             "queue_depth": float(self.backend.queue_depth()),
             "active_sessions": float(self.backend.active_sessions()),
             "http_inflight": float(self._inflight),
-        })
+        }
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.backend.metrics.prometheus(extra_gauges=gauges)
+        )
         writer.write(_response(
             "200 OK", text.encode(),
             content_type="text/plain; version=0.0.4; charset=utf-8",

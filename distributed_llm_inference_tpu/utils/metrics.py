@@ -23,6 +23,78 @@ _PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
 
 logger = logging.getLogger("distributed_llm_inference_tpu")
 
+# Central metric registry: every name emitted anywhere in the package,
+# declared once — name -> (kind, help). ``tools/distcheck`` (DC400/DC401)
+# enforces that emitters and this table never drift: an undeclared emit or
+# a dead declaration fails tier-1. ``*`` entries match dynamically
+# suffixed families (f-string names). Kinds: ``counter`` (monotonic,
+# ``_total`` on /metrics), ``gauge`` (last-write-wins), ``summary``
+# (observe()/timer() histories; ``_seconds`` on /metrics unless the name
+# carries its own unit suffix). Names here are pre-exposition — the
+# prometheus() renderer appends the suffixes, so declarations must not.
+METRICS = {
+    # engine: admission + sessions
+    "sessions_submitted": ("counter", "Sessions accepted by submit()"),
+    "sessions_finished": ("counter", "Sessions retired (any reason)"),
+    "sessions_rejected": ("counter", "Sessions refused at admission"),
+    "sessions_deadline_expired": ("counter", "Sessions reaped past deadline"),
+    "admit_sync_sessions": ("counter", "Sessions admitted synchronously"),
+    "admit_overlap_sessions": ("counter", "Sessions admitted via overlap"),
+    "admit_overlap_spill": ("counter", "Overlap admissions spilled to sync"),
+    "admit_overlap_inflight": ("gauge", "Prefills in flight behind decode"),
+    "admit_to_merge": ("summary", "Overlap admission to KV-merge latency"),
+    # engine: prefill / decode hot path
+    "prefill": ("summary", "Prefill dispatch latency"),
+    "prefill_tokens": ("counter", "Prompt tokens prefilled"),
+    "batched_prefills": ("counter", "Prefills served by batched dispatch"),
+    "ring_prefills": ("counter", "Prefills served by the ring pipeline"),
+    "prefix_cached_tokens": ("counter", "Prompt tokens served from prefix cache"),
+    "decode_step": ("summary", "One decode tick (dispatch+resolve)"),
+    "decode_resolve": ("summary", "Deferred decode fetch latency"),
+    "decode_tokens": ("counter", "Tokens emitted by decode"),
+    "cache_growths": ("counter", "KV cache reallocations"),
+    # engine: speculative decoding
+    "spec_adapt_window_resets": ("counter", "Adaptive-k A/B window resets"),
+    "spec_adapt_probes": ("counter", "Adaptive-k probe windows started"),
+    "spec_adapt_suspensions": ("counter", "Speculation suspensions (low accept)"),
+    # disaggregated prefill/decode
+    "disagg_prefills": ("counter", "Remote prefills exported"),
+    "disagg_admitted": ("counter", "Sessions admitted from shipped KV"),
+    "disagg_fallback_local": ("counter", "Disagg failures served locally"),
+    "disagg_kv_frames_sent": ("counter", "KV frames shipped to decode pool"),
+    "disagg_prefill_errors": ("counter", "Prefill-pool requests that errored"),
+    "kv_transfer_bytes": ("summary", "Shipped KV payload size per session"),
+    "kv_transfer_ms": ("summary", "KV ship+decode wall time per session"),
+    # distributed client / worker / relay plane
+    "connections_opened": ("counter", "Relay connections dialed"),
+    "failovers": ("counter", "Mid-generation worker re-routes"),
+    "stale_replies_discarded": ("counter", "Replies from abandoned attempts"),
+    "row_errors": ("counter", "Per-row errors inside batched replies"),
+    "client_batch_group": ("summary", "generate_many co-batch group size"),
+    "client_generate_errors": ("counter", "Client-side generate failures"),
+    "malformed_frames": ("counter", "Frames dropped by schema checks"),
+    "duplicate_hops_skipped": ("counter", "At-most-once hop dedup skips"),
+    "worker_restarts": ("counter", "Consume-thread watchdog restarts"),
+    "pool_batch_occupancy": ("summary", "Items per task-pool device call"),
+    "pool_batches_size_*": ("counter", "Task-pool batches by exact size"),
+    # serving gateway
+    "http_requests": ("counter", "Completion requests received"),
+    "http_429": ("counter", "Requests shed at capacity"),
+    "http_503_breaker": ("counter", "Requests failed fast by the breaker"),
+    "ttft": ("summary", "Gateway time to first token"),
+    "gateway_tokens": ("counter", "Tokens delivered to HTTP clients"),
+    "queue_depth": ("gauge", "Backend queue depth at scrape"),
+    "active_sessions": ("gauge", "Live backend sessions at scrape"),
+    "http_inflight": ("gauge", "Gateway in-flight completions"),
+    "engine_ttft": ("summary", "Engine-side TTFT (sync admission)"),
+    "engine_ttft_decode": ("summary", "Engine-side TTFT (overlap admission)"),
+    "engine_ttft_prefill": ("summary", "Engine-side TTFT (disagg prefill)"),
+    # circuit breaker
+    "breaker_state": ("gauge", "0 closed / 1 open / 2 half-open"),
+    "breaker_*_transitions": ("counter", "Breaker transitions into a state"),
+    "breaker_failures_recorded": ("counter", "Failure signals seen"),
+}
+
 
 class Metrics:
     """Thread-safe counters and timers (the serving loop runs host threads

@@ -1,0 +1,18 @@
+"""Seeded blocking-call-in-event-loop violations (DC200) — test fixture."""
+
+import time
+
+
+class Gateway:
+    async def tick(self):
+        time.sleep(0.1)  # DC200: blocks the loop
+
+    async def render(self):
+        return self.metrics.prometheus()  # DC200: lock + full sort
+
+    async def roundtrip(self):
+        return self.relay_client.get("q", timeout=1.0)  # DC200: relay RPC
+
+    async def sync(self, x):
+        x.block_until_ready()  # DC200: device sync
+        return x

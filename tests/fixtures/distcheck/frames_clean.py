@@ -1,0 +1,22 @@
+"""Schema-consistent twins of frames_violation.py — zero findings."""
+
+from distributed_llm_inference_tpu.distributed.messages import (
+    pack_frame,
+    unpack_frame,
+)
+
+_FIELDS = ("op", "gen_id", "seq")
+
+
+def produce(relay, gid, seq, payload):
+    relay.put("q", pack_frame({
+        "op": "forward",
+        "gen_id": gid,
+        "seq": seq,
+    }, payload))
+
+
+def consume(frame):
+    header, arr = unpack_frame(frame)
+    meta = {k: header.get(k) for k in _FIELDS}
+    return meta, arr

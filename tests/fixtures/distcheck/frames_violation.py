@@ -1,0 +1,26 @@
+"""Seeded relay-frame schema drift (DC500, DC501) — test fixture.
+
+Closed world: one producer, one consumer, resolvable on both sides.
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import (
+    pack_frame,
+    unpack_frame,
+)
+
+
+def produce(relay, gid, payload):
+    relay.put("q", pack_frame({
+        "op": "forward",
+        "gen_id": gid,
+        "ttl_hint": 3,  # DC501: no consumer ever reads ttl_hint
+    }, payload))
+
+
+def consume(frame):
+    header, arr = unpack_frame(frame)
+    if header.get("op") != "forward":
+        return None
+    gid = header["gen_id"]
+    seq = header.get("seqno")  # DC500: producers write no 'seqno'
+    return gid, seq, arr

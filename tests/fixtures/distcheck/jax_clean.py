@@ -1,0 +1,31 @@
+"""Disciplined twins of jax_violation.py — zero findings."""
+
+import jax
+
+
+def double_draw(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1, shape)
+    b = jax.random.normal(k2, shape)
+    return a, b
+
+
+def loop_fold(key, n):
+    out = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        out.append(jax.random.uniform(k))
+    return out
+
+
+def common_random_numbers(key, shape):
+    a = jax.random.uniform(key, shape)
+    # distcheck: key-reuse-ok(paired-sample variance reduction on purpose)
+    b = jax.random.uniform(key, shape)
+    return a, b
+
+
+def _decode_tick(state):
+    # distcheck: host-sync-ok(the single amortized per-tick fetch)
+    toks = jax.device_get(state.tokens)
+    return toks

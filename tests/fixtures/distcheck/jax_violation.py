@@ -1,0 +1,25 @@
+"""Seeded JAX-discipline violations (DC300, DC301) — test fixture.
+
+Lives under ``fixtures`` so the tick-path scope applies (DC301 covers
+``engine/`` plus fixture files).
+"""
+
+import jax
+
+
+def double_draw(key, shape):
+    a = jax.random.uniform(key, shape)
+    b = jax.random.normal(key, shape)  # DC300: key already consumed
+    return a, b
+
+
+def loop_reuse(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.uniform(key))  # DC300: same key every round
+    return out
+
+
+def _decode_tick(state):
+    toks = jax.device_get(state.tokens)  # DC301: host sync in tick path
+    return toks

@@ -1,0 +1,57 @@
+"""Annotated/disciplined twins of locks_violation.py — zero findings."""
+
+import threading
+
+
+class MixedGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def guarded(self):
+        with self._lock:
+            self.count = 1
+
+    def unguarded(self):
+        with self._lock:
+            self.count = 2
+
+
+class ThreadRace:
+    def __init__(self):
+        # distcheck: unguarded-ok(single writer; stale reads acceptable)
+        self.state = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        self.state = "running"
+
+    def reader(self):
+        return self.state
+
+
+class DeclaredGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # distcheck: guarded-by(_lock)
+
+    def good(self):
+        with self._lock:
+            self.items = [1]
+
+    def _drain_locked(self):  # *_locked convention: callers hold the lock
+        self.items = []
+
+    def helper(self):  # distcheck: holds-lock(_lock)
+        self.items.append(2)
+
+
+class LostUpdate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1

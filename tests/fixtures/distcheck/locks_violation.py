@@ -1,0 +1,52 @@
+"""Seeded lock-discipline violations — distcheck test fixture (never imported).
+
+One seeded finding per lock check: DC100 (mixed guarded/unguarded
+writes), DC101 (thread-entry write + cross-method access), DC102
+(declared guard violated), DC103 (unguarded read-modify-write).
+"""
+
+import threading
+
+
+class MixedGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def guarded(self):
+        with self._lock:
+            self.count = 1
+
+    def unguarded(self):
+        self.count = 2  # DC100: written under _lock in guarded()
+
+
+class ThreadRace:
+    def __init__(self):
+        self.state = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        self.state = "running"  # DC101: raced by reader()
+
+    def reader(self):
+        return self.state
+
+
+class DeclaredGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # distcheck: guarded-by(_lock)
+
+    def bad(self):
+        self.items = [1]  # DC102: _lock not held
+
+
+class LostUpdate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        self.hits += 1  # DC103: non-atomic, no lock, class owns a lock

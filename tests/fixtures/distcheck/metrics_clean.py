@@ -1,0 +1,19 @@
+"""Registry-disciplined twins of metrics_violation.py — zero findings."""
+
+METRICS = {
+    "requests_served": ("counter", "Requests completed"),
+    "queue_wait": ("summary", "Time queued before dispatch"),
+    "shard_rebalance_*": ("counter", "Rebalances by shard family"),
+}
+
+
+class Emitter:
+    def serve(self, metrics, shard, wait_s):
+        metrics.counter("requests_served")
+        metrics.observe("queue_wait", wait_s)
+        metrics.counter(f"shard_rebalance_{shard}")
+        name = "requests_served" if wait_s else "requests_served"
+        metrics.counter(name)  # resolved via the local conditional
+        dyn = compute_name()
+        # distcheck: metric(requests_served)
+        metrics.counter(dyn)

@@ -1,0 +1,21 @@
+"""Seeded metrics-registry violations (DC400-DC402) — test fixture.
+
+Carries its own ``METRICS`` table so the checker runs in a closed world.
+"""
+
+METRICS = {
+    "requests_served": ("counter", "Requests completed"),
+    "queue_wait": ("summary", "Time queued before dispatch"),
+    "orphan_metric": ("counter", "Declared but never emitted"),  # DC401
+    "bytes_sent_total": ("counter", "Reserved suffix in the name"),  # DC402
+    "depth": ("dial", "Unknown kind"),  # DC402
+}
+
+
+class Emitter:
+    def serve(self, metrics, n):
+        metrics.counter("requests_served")
+        metrics.counter("requests_servd")  # DC400: typo'd name drift
+        metrics.gauge("queue_wait", n)  # DC400: declared summary, used gauge
+        name = compute_name()
+        metrics.counter(name)  # DC400: not statically resolvable

@@ -1,0 +1,182 @@
+"""Tier-1 gate for the ``tools.distcheck`` static analyzer.
+
+Three layers:
+
+* **Package gate** — the analyzer over the whole
+  ``distributed_llm_inference_tpu/`` package must report **zero**
+  unsuppressed findings.  Any new unguarded shared-state write, blocking
+  call in the gateway event loop, PRNG key reuse, undeclared metric, or
+  relay-frame schema drift fails tier-1 here, not in production.
+* **Detection** — every checker must fire on its seeded-violation
+  fixture in ``tests/fixtures/distcheck/`` with the exact CHECK-ID
+  multiset the fixture documents.  This proves the gate is not green
+  because the analyzer went blind.
+* **Suppression** — each fixture's annotated twin (``*_clean.py``) must
+  be silent, proving the ``# distcheck:`` annotation grammar works, and
+  the baseline file mechanism must suppress by fingerprint.
+
+No device, no model weights, no network: pure AST work — tier-1 cheap.
+"""
+
+from __future__ import annotations
+
+import io
+import subprocess
+import sys
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:  # `python -m pytest` from elsewhere
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.distcheck import core  # noqa: E402
+
+PACKAGE = REPO_ROOT / "distributed_llm_inference_tpu"
+FIXTURES = REPO_ROOT / "tests" / "fixtures" / "distcheck"
+
+
+def _ids(path: Path) -> Counter:
+    findings, errors = core.analyze([str(path)])
+    assert not errors, f"parse errors in {path}: {errors}"
+    return Counter(f.check_id for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# package gate
+# ---------------------------------------------------------------------------
+
+
+def test_package_has_zero_unsuppressed_findings():
+    """The whole package is clean modulo the checked-in baseline."""
+    findings, errors = core.analyze([str(PACKAGE)])
+    assert not errors, f"distcheck failed to parse package files: {errors}"
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    fresh = [f for f in findings if f.fingerprint() not in baseline]
+    rendered = "\n".join(f.render() for f in fresh)
+    assert not fresh, f"unsuppressed distcheck findings:\n{rendered}"
+
+
+def test_run_exit_code_clean_on_package():
+    buf = io.StringIO()
+    rc = core.run([str(PACKAGE)], baseline=core.DEFAULT_BASELINE, out=buf)
+    assert rc == 0, buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# detection: every checker fires on its seeded fixture
+# ---------------------------------------------------------------------------
+
+_EXPECTED = {
+    "locks_violation.py": {
+        "DC100": 1,  # MixedGuard.pending: written both under + outside lock
+        "DC101": 1,  # ThreadRace.processed: thread entry vs. foreign reader
+        "DC102": 1,  # DeclaredGuard.inflight: guarded-by(_lock) violated
+        "DC103": 1,  # LostUpdate.total += outside lock in threaded class
+    },
+    "async_violation.py": {
+        "DC200": 4,  # time.sleep / .prometheus() / relay get / sync wait
+    },
+    "jax_violation.py": {
+        "DC300": 2,  # double-consumed key; loop reuse of pre-loop key
+        "DC301": 1,  # device_get inside a tick-path function
+    },
+    "metrics_violation.py": {
+        "DC400": 3,  # typo'd name; kind mismatch; unresolvable name
+        "DC401": 3,  # orphan + two bad-name registry entries never emitted
+        "DC402": 2,  # reserved suffix; unknown kind
+    },
+    "frames_violation.py": {
+        "DC500": 1,  # consumer reads 'seqno' no producer writes
+        "DC501": 1,  # producer writes 'ttl_hint' no consumer reads
+    },
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(_EXPECTED))
+def test_checker_detects_seeded_violations(fixture):
+    got = _ids(FIXTURES / fixture)
+    assert got == Counter(_EXPECTED[fixture]), (
+        f"{fixture}: expected {dict(_EXPECTED[fixture])}, got {dict(got)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# suppression: annotated twins are silent
+# ---------------------------------------------------------------------------
+
+_CLEAN = [
+    "locks_clean.py",
+    "async_clean.py",
+    "jax_clean.py",
+    "metrics_clean.py",
+    "frames_clean.py",
+]
+
+
+@pytest.mark.parametrize("fixture", _CLEAN)
+def test_annotations_suppress_clean_twin(fixture):
+    got = _ids(FIXTURES / fixture)
+    assert not got, f"{fixture} should be silent, got {dict(got)}"
+
+
+def test_baseline_suppresses_by_fingerprint(tmp_path):
+    """A baseline entry (no line numbers) silences a known finding."""
+    target = FIXTURES / "frames_violation.py"
+    findings, _ = core.analyze([str(target)])
+    assert findings
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "# temp baseline\n"
+        + "\n".join(f.fingerprint() for f in findings)
+        + "\n"
+    )
+    buf = io.StringIO()
+    rc = core.run([str(target)], baseline=baseline, out=buf)
+    assert rc == 0, buf.getvalue()
+    assert "baselined" in buf.getvalue()
+
+
+def test_ignore_pragma_suppresses_single_check(tmp_path):
+    src = FIXTURES / "frames_violation.py"
+    text = src.read_text().replace(
+        'seq = header.get("seqno")',
+        'seq = header.get("seqno")  '
+        "# distcheck: ignore[DC500](phase-2 producers ship it)",
+    )
+    clone = tmp_path / "frames_ignored.py"
+    clone.write_text(text)
+    got = _ids(clone)
+    assert got == Counter({"DC501": 1}), dict(got)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def test_module_cli_exit_codes():
+    env_cmd = [sys.executable, "-m", "tools.distcheck"]
+    ok = subprocess.run(
+        env_cmd + [str(FIXTURES / "locks_clean.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        env_cmd + ["--no-baseline", str(FIXTURES / "locks_violation.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "DC10" in bad.stdout
+
+
+def test_distribute_check_subcommand():
+    from distributed_llm_inference_tpu import cli
+
+    rc = cli.main(["check", str(FIXTURES / "async_clean.py")])
+    assert rc == 0
+    rc = cli.main(["check", "--no-baseline",
+                   str(FIXTURES / "async_violation.py")])
+    assert rc == 1

@@ -1,0 +1,10 @@
+"""distcheck — project-invariant static analysis for this repo.
+
+``python -m tools.distcheck [paths]`` or ``distribute check [paths]``.
+See ``core.py`` for the annotation grammar and the README's
+"Static analysis" section for the CHECK-ID catalogue.
+"""
+
+from .core import DEFAULT_BASELINE, Finding, analyze, run  # noqa: F401
+
+__all__ = ["Finding", "analyze", "run", "DEFAULT_BASELINE"]

@@ -1,0 +1,39 @@
+"""CLI: ``python -m tools.distcheck [paths...]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import DEFAULT_BASELINE, REPO_ROOT, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distcheck",
+        description="Project-invariant static analyzer (lock discipline, "
+        "async blocking calls, PRNG/host-sync hygiene, metrics registry, "
+        "relay-frame schema).",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        default=[str(REPO_ROOT / "distributed_llm_inference_tpu")],
+        help="files/directories to analyze (default: the package)",
+    )
+    ap.add_argument(
+        "--baseline", type=Path, default=DEFAULT_BASELINE,
+        help="suppression baseline file (default: tools/distcheck/"
+        "baseline.txt)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report baselined findings too",
+    )
+    args = ap.parse_args(argv)
+    baseline = None if args.no_baseline else args.baseline
+    return run(args.paths, baseline=baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""Blocking-call-in-event-loop lint (DC200).
+
+Inside ``async def`` bodies, flag calls that park the event loop: the
+accept loop and every in-flight SSE stream stall behind them.
+
+* ``time.sleep(...)`` — use ``asyncio.sleep``.
+* ``socket.*`` constructors and raw socket I/O methods.
+* Device syncs: ``jax.device_get(...)``, ``.block_until_ready()``.
+* Known-blocking project calls: ``.stop()`` / ``.join()`` (thread
+  joins), ``.prometheus()`` / ``.snapshot()`` (lock + full-history
+  sorts), ``Future.result()``, and relay round-trips (``.put`` / ``.get``
+  / ``.put_many`` / ``.rpc`` / ``.ping`` on relay/client-named
+  receivers).
+
+The fix is ``await loop.run_in_executor(None, fn, ...)`` or handing the
+work to the backend's driver thread. A call that is deliberately
+blocking (bounded, cold path) takes ``# distcheck: blocking-ok(reason)``.
+
+``await``-ed expressions are exempt by construction: awaiting
+``run_in_executor(...)`` wraps the blocking call in a worker thread.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Finding, SourceFile, call_name, dotted, register
+
+# Attribute calls that block regardless of receiver.
+_BLOCKING_ATTRS = {
+    "stop": "joins worker threads",
+    "join": "joins a thread",
+    "block_until_ready": "synchronizes with the device",
+    "prometheus": "takes the metrics lock and sorts full timing history",
+    "snapshot": "takes the metrics lock and sorts full timing history",
+    "log_snapshot": "takes the metrics lock and sorts full timing history",
+    "result": "blocks on a Future",
+}
+# Relay round-trip methods, when the receiver looks like a relay/client.
+_RELAY_ATTRS = {"put", "get", "put_many", "rpc", "ping", "cancel_queue"}
+_RELAY_RECEIVERS = ("relay", "client", "conn", "_out", "_directory")
+_SOCKET_IO = {
+    "recv", "recv_into", "sendall", "send", "accept", "connect", "makefile",
+}
+
+
+def _blocking_reason(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name == "time.sleep":
+        return "time.sleep blocks the event loop — use asyncio.sleep"
+    if name.startswith("socket."):
+        return f"raw {name}() in the event loop"
+    if name in ("jax.device_get", "jax.block_until_ready"):
+        return f"{name} synchronizes with the device"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}() {_BLOCKING_ATTRS[attr]}"
+        base = dotted(node.func.value).rsplit(".", 1)[-1].lower()
+        if attr in _RELAY_ATTRS and any(
+            key in base for key in _RELAY_RECEIVERS
+        ):
+            return f"relay round-trip .{attr}() on {dotted(node.func.value)}"
+        if attr in _SOCKET_IO and ("sock" in base or "socket" in base):
+            return f"socket .{attr}() in the event loop"
+    return None
+
+
+class _AsyncScan(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, fn: str):
+        self.sf = sf
+        self.fn = fn
+        self.out: List[Finding] = []
+
+    def visit_Await(self, node: ast.Await) -> None:
+        # Whatever is awaited was made loop-safe (run_in_executor, native
+        # coroutine) — don't descend into the awaited call itself, but do
+        # scan its arguments.
+        v = node.value
+        if isinstance(v, ast.Call):
+            for arg in list(v.args) + [kw.value for kw in v.keywords]:
+                self.visit(arg)
+        else:
+            self.visit(v)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        reason = _blocking_reason(node)
+        if reason is not None and (
+            self.sf.ann.at(node.lineno, "blocking-ok") is None
+        ):
+            self.out.append(Finding(
+                "DC200", self.sf.path, node.lineno,
+                f"{self.fn}:{call_name(node) or 'call'}",
+                f"blocking call in async def {self.fn}(): {reason}; move "
+                "it to run_in_executor or annotate blocking-ok(reason)",
+            ))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # nested sync defs run elsewhere
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # scanned separately
+        pass
+
+    def visit_Lambda(self, node):  # executor thunks run off-loop
+        pass
+
+
+@register
+def check(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                scan = _AsyncScan(sf, node.name)
+                for stmt in node.body:
+                    scan.visit(stmt)
+                out.extend(scan.out)
+    return out

@@ -1,0 +1,256 @@
+"""distcheck core: findings, annotations, baseline, file collection, runner.
+
+The analyzer is AST-based and project-specific: it encodes THIS repo's
+invariants (lock discipline around the serving threads, PRNG-split order,
+tick-path host-sync budget, the metrics registry, relay-frame schema)
+rather than generic style rules. Checkers live in sibling modules and
+register through :data:`CHECKERS`; each takes the full list of parsed
+files (two of them — metrics and frames — are whole-program checks).
+
+Annotation grammar (comments, same line as the statement or the line
+directly above it)::
+
+    # distcheck: guarded-by(_lock)         declare an attribute's guard
+    # distcheck: unguarded-ok(reason)      shared attr is safe by design
+    # distcheck: holds-lock(_lock)         method runs with the lock held
+    # distcheck: blocking-ok(reason)       blocking call in async is fine
+    # distcheck: host-sync-ok(reason)      tick-path host sync is budgeted
+    # distcheck: key-reuse-ok(reason)      PRNG key reuse is intended
+    # distcheck: metric(name_a, name_b)    names a computed metric resolves to
+    # distcheck: ignore[DC###](reason)     suppress one check on this line
+
+Findings print as ``path:line CHECK-ID message``. ``baseline.txt`` (next
+to this file) suppresses known findings by stable fingerprint
+(``CHECK-ID path symbol`` — no line numbers, so unrelated edits don't
+invalidate it); the intended steady state is an EMPTY baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.txt"
+
+_ANN_RE = re.compile(
+    r"#\s*distcheck:\s*([a-z][a-z-]*)\s*(?:\(([^)]*)\))?"
+)
+_IGNORE_RE = re.compile(
+    r"#\s*distcheck:\s*ignore\[([A-Z0-9,\s]+)\]\s*(?:\(([^)]*)\))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check_id: str
+    path: str  # repo-relative posix path
+    line: int
+    symbol: str  # stable anchor (Class.attr, function name) for baselining
+    message: str
+
+    def fingerprint(self) -> str:
+        return f"{self.check_id} {self.path} {self.symbol}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.check_id} {self.message}"
+
+
+class Annotations:
+    """``# distcheck:`` directives extracted from raw source lines.
+
+    A directive applies to the statement on its own line; a standalone
+    comment line applies to the statement on the next line.
+    """
+
+    def __init__(self, lines: Sequence[str]):
+        self._by_line: Dict[int, List[Tuple[str, str]]] = {}
+        self._ignores: Dict[int, List[str]] = {}
+        for i, text in enumerate(lines, start=1):
+            if "distcheck" not in text:
+                continue
+            m = _IGNORE_RE.search(text)
+            if m:
+                ids = [s.strip() for s in m.group(1).split(",") if s.strip()]
+                self._ignores.setdefault(i, []).extend(ids)
+                continue
+            for m in _ANN_RE.finditer(text):
+                name, args = m.group(1), (m.group(2) or "").strip()
+                self._by_line.setdefault(i, []).append((name, args))
+        # A pure-comment line annotates the next line too.
+        self._comment_lines = {
+            i for i, text in enumerate(lines, start=1)
+            if text.lstrip().startswith("#")
+        }
+
+    def _lines_for(self, line: int) -> List[int]:
+        out = [line]
+        j = line - 1
+        while j in self._comment_lines:
+            out.append(j)
+            j -= 1
+        return out
+
+    def at(self, line: int, name: str) -> Optional[str]:
+        """Return the args string of directive ``name`` covering ``line``
+        (same line or the comment block directly above), else None."""
+        for ln in self._lines_for(line):
+            for n, args in self._by_line.get(ln, []):
+                if n == name:
+                    return args
+        return None
+
+    def ignored(self, line: int, check_id: str) -> bool:
+        for ln in self._lines_for(line):
+            if check_id in self._ignores.get(ln, []):
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str  # repo-relative posix path
+    abspath: Path
+    tree: ast.Module
+    lines: List[str]
+    ann: Annotations
+
+
+def _relpath(p: Path) -> str:
+    p = p.resolve()
+    try:
+        return p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def collect_files(paths: Sequence[str]) -> Tuple[List[SourceFile], List[str]]:
+    """Parse every ``.py`` under ``paths``. Returns (files, errors)."""
+    seen: Dict[str, SourceFile] = {}
+    errors: List[str] = []
+    for raw in paths:
+        root = Path(raw)
+        candidates = (
+            sorted(root.rglob("*.py")) if root.is_dir() else [root]
+        )
+        for f in candidates:
+            if "__pycache__" in f.parts:
+                continue
+            rel = _relpath(f)
+            if rel in seen:
+                continue
+            try:
+                src = f.read_text()
+                tree = ast.parse(src, filename=str(f))
+            except (OSError, SyntaxError) as e:
+                errors.append(f"{rel}: {e}")
+                continue
+            lines = src.splitlines()
+            seen[rel] = SourceFile(rel, f, tree, lines, Annotations(lines))
+    return list(seen.values()), errors
+
+
+def load_baseline(path: Optional[Path] = None) -> set:
+    path = path or DEFAULT_BASELINE
+    out = set()
+    if path.is_file():
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+# -- AST helpers shared by checkers ------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('jax.random.split', 'self.m.counter')."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ".".join(reversed(parts)) if parts else ""
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is ``self.x``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+# -- runner ------------------------------------------------------------------
+
+CHECKERS: List[Callable[[List[SourceFile]], List[Finding]]] = []
+
+
+def register(fn: Callable[[List[SourceFile]], List[Finding]]):
+    CHECKERS.append(fn)
+    return fn
+
+
+def _load_checkers() -> None:
+    if CHECKERS:
+        return
+    from . import asynclint, frames, jaxlint, locks, metriclint  # noqa: F401
+
+
+def analyze(paths: Sequence[str]) -> Tuple[List[Finding], List[str]]:
+    """Run every checker; returns (findings, parse_errors). Findings with a
+    generic ``ignore[DC###]`` annotation are already dropped."""
+    _load_checkers()
+    files, errors = collect_files(paths)
+    by_path = {f.path: f for f in files}
+    findings: List[Finding] = []
+    for check in CHECKERS:
+        for fd in check(files):
+            sf = by_path.get(fd.path)
+            if sf is not None and sf.ann.ignored(fd.line, fd.check_id):
+                continue
+            findings.append(fd)
+    findings.sort(key=lambda f: (f.path, f.line, f.check_id))
+    return findings, errors
+
+
+def run(
+    paths: Sequence[str],
+    baseline: Optional[Path] = DEFAULT_BASELINE,
+    out=None,
+) -> int:
+    """CLI entry: print findings, return process exit code (0 = clean)."""
+    import sys
+
+    out = out or sys.stdout
+    findings, errors = analyze(paths)
+    for e in errors:
+        print(f"distcheck: parse error: {e}", file=out)
+    base = load_baseline(baseline) if baseline else set()
+    suppressed = 0
+    shown: List[Finding] = []
+    for fd in findings:
+        if fd.fingerprint() in base:
+            suppressed += 1
+        else:
+            shown.append(fd)
+    for fd in shown:
+        print(fd.render(), file=out)
+    tail = f"{len(shown)} finding(s)"
+    if suppressed:
+        tail += f", {suppressed} baselined"
+    print(f"distcheck: {tail} across {len(paths)} path(s)", file=out)
+    return 1 if (shown or errors) else 0

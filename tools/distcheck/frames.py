@@ -1,0 +1,324 @@
+"""Relay-frame schema consistency (DC500, DC501).
+
+Producers build header dicts and hand them to ``pack_frame(header, …)``
+(or kv_codec's ``_pack``); consumers take headers back from
+``unpack_frame`` / ``_unpack`` and read fields via ``header.get("k")`` /
+``header["k"]``. The wire schema lives only in these dict literals — the
+exact drift this checker pins down:
+
+* **DC500** — a consumer reads a header field no producer ever writes
+  (typo, or a producer was changed without its consumers).
+* **DC501** — a producer writes a field no consumer ever reads (dead
+  payload bytes on every frame, or the consumer was dropped).
+
+Extraction is whole-program across the scanned set: produced keys come
+from dict literals (including ``{**base, "k": v}`` spreads and
+``dict(base, k=v)`` resolved through local single assignments, and
+``{k: h.get(k) for k in _FIELDS}`` comprehensions over module-level
+tuples); consumed keys follow the header variable interprocedurally one
+call deep into same-module functions. A header that escapes beyond that
+(stored, returned, forwarded wholesale) counts as consuming everything,
+so DC501 only fires in a closed world. Both checks need at least one
+producer AND one consumer in the scan — a subset scan stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, call_name, register
+
+_PACKERS = {"pack_frame", "_pack"}
+_UNPACKERS = {"unpack_frame", "_unpack"}
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _module_str_seqs(tree: ast.Module) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+            continue
+        vals = [_const_str(e) for e in node.value.elts]
+        if any(v is None for v in vals):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = [v for v in vals if v is not None]
+    return out
+
+
+class _Producer:
+    def __init__(self):
+        self.keys: Dict[str, Tuple[str, int]] = {}  # key -> (path, line)
+        self.open = False  # unresolvable part: unknown extra keys
+
+
+def _dict_keys(
+    node: ast.AST,
+    fn_node: ast.AST,
+    mod_seqs: Dict[str, List[str]],
+    depth: int = 0,
+) -> Tuple[Set[str], bool]:
+    """(keys, open) for a header expression."""
+    if depth > 4:
+        return set(), True
+    if isinstance(node, ast.Dict):
+        keys: Set[str] = set()
+        opened = False
+        for k, v in zip(node.keys, node.values):
+            if k is None:  # {**spread}
+                sub, o = _dict_keys(v, fn_node, mod_seqs, depth + 1)
+                keys |= sub
+                opened |= o
+            else:
+                s = _const_str(k)
+                if s is None:
+                    opened = True
+                else:
+                    keys.add(s)
+        return keys, opened
+    if isinstance(node, ast.Call) and call_name(node) == "dict":
+        keys, opened = set(), False
+        if node.args:
+            sub, o = _dict_keys(node.args[0], fn_node, mod_seqs, depth + 1)
+            keys |= sub
+            opened |= o
+        for kw in node.keywords:
+            if kw.arg is None:
+                sub, o = _dict_keys(kw.value, fn_node, mod_seqs, depth + 1)
+                keys |= sub
+                opened |= o
+            else:
+                keys.add(kw.arg)
+        return keys, opened
+    if isinstance(node, ast.DictComp):
+        it = node.generators[0].iter if node.generators else None
+        if isinstance(it, ast.Name) and it.id in mod_seqs:
+            return set(mod_seqs[it.id]), False
+        return set(), True
+    if isinstance(node, ast.Name):
+        # Resolve local assignments within the enclosing function; several
+        # (e.g. one per branch) union — the wire may carry any of them.
+        assigns = []
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == node.id:
+                        assigns.append(sub.value)
+        if assigns:
+            keys: Set[str] = set()
+            opened = False
+            for a in assigns:
+                sub_keys, o = _dict_keys(a, fn_node, mod_seqs, depth + 1)
+                keys |= sub_keys
+                opened |= o
+            return keys, opened
+        return set(), True
+    return set(), True
+
+
+class _ParamUse:
+    """How one function uses one of its dict parameters."""
+
+    def __init__(self):
+        self.keys: Dict[str, int] = {}  # key -> line
+        self.escapes = False
+        self.forwards: List[Tuple[str, int]] = []  # (callee, arg position)
+
+
+def _loop_vars(fn_node, mod_seqs: Dict[str, List[str]]) -> Dict[str, List[str]]:
+    """Loop/comprehension variables iterating a module-level str tuple:
+    ``for k in _FIELDS`` makes ``h.get(k)`` consume every field."""
+    out: Dict[str, List[str]] = {}
+    for node in ast.walk(fn_node):
+        gens = []
+        if isinstance(node, ast.For):
+            gens = [(node.target, node.iter)]
+        elif isinstance(node, (ast.DictComp, ast.SetComp, ast.ListComp,
+                               ast.GeneratorExp)):
+            gens = [(g.target, g.iter) for g in node.generators]
+        for tgt, it in gens:
+            if isinstance(tgt, ast.Name) and isinstance(it, ast.Name) and (
+                it.id in mod_seqs
+            ):
+                out[tgt.id] = mod_seqs[it.id]
+    return out
+
+
+def _scan_var_uses(
+    fn_node, var: str, mod_seqs: Dict[str, List[str]]
+) -> _ParamUse:
+    """Every recognized consumption of ``var`` is accounted for; ANY other
+    appearance of the name (stuffed into a tuple bound for a queue, stored,
+    returned, iterated) marks an escape — the conservative reading is that
+    an escaped header may be read in full somewhere we can't see."""
+    use = _ParamUse()
+    loop_vars = _loop_vars(fn_node, mod_seqs)
+    handled: set = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            fname = call_name(node)
+            if isinstance(node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Name
+            ) and node.func.value.id == var:
+                handled.add(id(node.func.value))
+                if node.func.attr == "get" and node.args:
+                    s = _const_str(node.args[0])
+                    if s is not None:
+                        use.keys.setdefault(s, node.args[0].lineno)
+                        continue
+                    a = node.args[0]
+                    if isinstance(a, ast.Name) and a.id in loop_vars:
+                        for s in loop_vars[a.id]:
+                            use.keys.setdefault(s, node.lineno)
+                        continue
+                use.escapes = True  # h.items(), h.keys(), h.pop(dyn), ...
+                continue
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id == var:
+                    handled.add(id(arg))
+                    short = fname.rsplit(".", 1)[-1]
+                    if short in ("len", "bool", "repr", "str", "print"):
+                        continue
+                    use.forwards.append((short, i))
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Name) and node.value.id == var:
+                handled.add(id(node.value))
+                s = _const_str(node.slice)
+                if s is not None:
+                    use.keys.setdefault(s, node.lineno)
+                elif isinstance(node.slice, ast.Name) and (
+                    node.slice.id in loop_vars
+                ):
+                    for s in loop_vars[node.slice.id]:
+                        use.keys.setdefault(s, node.lineno)
+                else:
+                    use.escapes = True
+        elif isinstance(node, ast.Compare):
+            # "k" in header
+            if (
+                len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.comparators[0], ast.Name)
+                and node.comparators[0].id == var
+            ):
+                handled.add(id(node.comparators[0]))
+                s = _const_str(node.left)
+                if s is not None:
+                    use.keys.setdefault(s, node.lineno)
+    for node in ast.walk(fn_node):
+        if (
+            isinstance(node, ast.Name)
+            and node.id == var
+            and isinstance(node.ctx, ast.Load)
+            and id(node) not in handled
+        ):
+            use.escapes = True
+            break
+    return use
+
+
+@register
+def check(files: List[SourceFile]) -> List[Finding]:
+    produced: Dict[str, Tuple[str, int]] = {}
+    any_open_producer = False
+    consumed: Dict[str, Tuple[str, int]] = {}
+    wildcard_consumer = False
+    n_producers = n_consumers = 0
+
+    # Per-module function table for one-deep interprocedural follow.
+    fn_tables: Dict[str, Dict[str, ast.AST]] = {}
+    for sf in files:
+        table: Dict[str, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table.setdefault(node.name, node)
+        fn_tables[sf.path] = table
+
+    def consume_via(
+        sf: SourceFile, fn_node, var: str, mod_seqs, depth: int
+    ) -> None:
+        nonlocal wildcard_consumer
+        use = _scan_var_uses(fn_node, var, mod_seqs)
+        for k, line in use.keys.items():
+            consumed.setdefault(k, (sf.path, line))
+        if use.escapes or depth >= 3:
+            if use.escapes:
+                wildcard_consumer = True
+            return
+        for callee, pos in use.forwards:
+            target = fn_tables[sf.path].get(callee)
+            if target is None:
+                wildcard_consumer = True
+                continue
+            params = [a.arg for a in target.args.args]
+            if params and params[0] == "self":
+                pos += 1
+            if pos < len(params):
+                consume_via(sf, target, params[pos], mod_seqs, depth + 1)
+
+    for sf in files:
+        mod_seqs = _module_str_seqs(sf.tree)
+        for fn_node in ast.walk(sf.tree):
+            if not isinstance(
+                fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Call):
+                    short = call_name(node).rsplit(".", 1)[-1]
+                    if short in _PACKERS and node.args:
+                        n_producers += 1
+                        keys, opened = _dict_keys(
+                            node.args[0], fn_node, mod_seqs
+                        )
+                        any_open_producer |= opened
+                        for k in keys:
+                            produced.setdefault(k, (sf.path, node.lineno))
+                elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    short = call_name(node.value).rsplit(".", 1)[-1]
+                    if short not in _UNPACKERS:
+                        continue
+                    n_consumers += 1
+                    tgt = node.targets[0]
+                    header_var = None
+                    if isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts:
+                        if isinstance(tgt.elts[0], ast.Name):
+                            header_var = tgt.elts[0].id
+                    elif isinstance(tgt, ast.Name):
+                        header_var = tgt.id
+                    if header_var and header_var != "_":
+                        consume_via(sf, fn_node, header_var, mod_seqs, 0)
+
+    out: List[Finding] = []
+    if n_producers == 0 or n_consumers == 0:
+        return out
+    if not any_open_producer:
+        for k, (path, line) in sorted(consumed.items()):
+            if k not in produced:
+                out.append(Finding(
+                    "DC500", path, line, f"frame.{k}",
+                    f"consumer reads frame header field '{k}' that no "
+                    "producer in the scanned set ever writes — schema "
+                    "drift (typo, or the producer changed)",
+                ))
+    if not wildcard_consumer:
+        for k, (path, line) in sorted(produced.items()):
+            if k not in consumed:
+                out.append(Finding(
+                    "DC501", path, line, f"frame.{k}",
+                    f"producer writes frame header field '{k}' that no "
+                    "consumer in the scanned set ever reads — dead bytes "
+                    "on every frame",
+                ))
+    return out

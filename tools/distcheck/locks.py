@@ -1,0 +1,300 @@
+"""Lock-discipline / race detection (DC100-DC103).
+
+Per class, infer the set of lock attributes (``self._lock =
+threading.Lock()`` and friends), which methods run on their own threads
+(``Thread(target=self.m)``, ``TaskPool(self.m, ...)``,
+``run_in_executor(None, self.m)``), and which attribute accesses happen
+under ``with self._lock:``. Then:
+
+* **DC100** — attribute written both under a lock and outside any lock
+  (in a non-``__init__`` method): the guard is advisory, i.e. broken.
+* **DC101** — attribute written without a lock inside a thread-entry
+  method while some *other* method also touches it: a cross-thread race.
+* **DC102** — attribute explicitly declared ``guarded-by(L)`` written
+  without holding ``L``.
+* **DC103** — non-atomic read-modify-write (``self.x += 1``) outside any
+  lock in a class that owns locks or threads: the classic lost update.
+
+Methods named ``*_locked`` or annotated ``holds-lock(L)`` are treated as
+running with the lock held (callers take it). ``unguarded-ok(reason)``
+on any write site exempts that attribute (single-owner state, GIL-atomic
+appends, event-loop-confined counters — intent, documented). ``__init__``
+writes never count: construction happens-before publication.
+
+Scope: the threaded serving tiers (``distributed/``, ``serving/``,
+``disagg/``, ``utils/``). The engine is excluded by path — its
+lock-free admission fast path is a documented design (engine.py keeps
+GIL-atomic deque/dict handoffs on purpose) that a lock-inference pass
+would misread.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, call_name, register, self_attr
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore")
+_THREAD_SPAWNERS = ("Thread", "Timer", "TaskPool")
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "appendleft", "remove",
+    "discard", "add", "clear", "update", "setdefault",
+}
+_INIT_METHODS = {"__init__", "__new__", "__post_init__"}
+# Directories whose files this checker skips (documented lock-free designs
+# and pure-math code where lock inference has nothing to say).
+_SKIP_SEGMENTS = {"engine", "models", "ops", "kernels", "pallas"}
+
+
+@dataclasses.dataclass
+class Access:
+    method: str
+    line: int
+    kind: str  # 'write' | 'aug' | 'mutate' | 'read'
+    locks: Tuple[str, ...]  # locks held at the access site
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Record attribute accesses + held-lock sets within one method."""
+
+    def __init__(self, cls: "_ClassInfo", method: str, base_locks: Set[str]):
+        self.cls = cls
+        self.method = method
+        self.locks: List[str] = sorted(base_locks)
+
+    def _record(self, attr: str, line: int, kind: str) -> None:
+        self.cls.accesses.setdefault(attr, []).append(
+            Access(self.method, line, kind, tuple(self.locks))
+        )
+
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            ctx = item.context_expr
+            attr = self_attr(ctx)
+            if attr is None and isinstance(ctx, ast.Call):
+                attr = self_attr(ctx.func)  # with self._cond: vs .acquire()
+            if attr is not None and attr in self.cls.lock_attrs:
+                held.append(attr)
+        self.locks.extend(held)
+        self.generic_visit(node)
+        for _ in held:
+            self.locks.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._targets(tgt)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._targets(node.target)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = self_attr(node.target)
+        if attr is not None:
+            self._record(attr, node.lineno, "aug")
+        else:
+            self._targets(node.target)
+        self.visit(node.value)
+
+    def _targets(self, tgt: ast.AST) -> None:
+        attr = self_attr(tgt)
+        if attr is not None:
+            self._record(attr, tgt.lineno, "write")
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._targets(elt)
+        elif isinstance(tgt, ast.Subscript):
+            base = self_attr(tgt.value)
+            if base is not None:
+                self._record(base, tgt.lineno, "mutate")
+            else:
+                self.visit(tgt.value)
+            self.visit(tgt.slice)
+        elif isinstance(tgt, ast.Attribute):
+            self.visit(tgt.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self.x.append(...) — mutation of self.x through a method.
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            base = self_attr(node.func.value)
+            if base is not None:
+                self._record(base, node.lineno, "mutate")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._record(attr, node.lineno, "read")
+        self.generic_visit(node)
+
+    # Don't descend into nested defs/classes: their bodies run later, on
+    # whatever thread calls them — a separate analysis unit.
+    def visit_FunctionDef(self, node):  # noqa: D102
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: D102
+        pass
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    lock_attrs: Set[str] = dataclasses.field(default_factory=set)
+    thread_entries: Set[str] = dataclasses.field(default_factory=set)
+    spawns_threads: bool = False
+    accesses: Dict[str, List[Access]] = dataclasses.field(default_factory=dict)
+    declared: Dict[str, Tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )  # attr -> (lock, decl line)
+    exempt: Set[str] = dataclasses.field(default_factory=set)
+
+
+def _scan_class(sf: SourceFile, node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node.name)
+    methods = [
+        n for n in node.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    # Pass 1: lock attrs, thread entries, declarations, exemptions.
+    for m in methods:
+        for sub in ast.walk(m):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                ctor = call_name(sub.value).rsplit(".", 1)[-1]
+                if ctor in _LOCK_CTORS:
+                    for tgt in sub.targets:
+                        attr = self_attr(tgt)
+                        if attr is not None:
+                            info.lock_attrs.add(attr)
+            if isinstance(sub, ast.Call):
+                fn = call_name(sub).rsplit(".", 1)[-1]
+                if fn in _THREAD_SPAWNERS or fn in (
+                    "run_in_executor", "submit", "call_soon_threadsafe",
+                ):
+                    if fn in ("Thread", "Timer"):
+                        info.spawns_threads = True
+                    for arg in list(sub.args) + [
+                        kw.value for kw in sub.keywords
+                    ]:
+                        attr = self_attr(arg)
+                        if attr is not None:
+                            info.thread_entries.add(attr)
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for tgt in tgts:
+                    attr = self_attr(tgt)
+                    if attr is None:
+                        continue
+                    decl = sf.ann.at(tgt.lineno, "guarded-by")
+                    if decl:
+                        info.declared[attr] = (decl.strip(), tgt.lineno)
+                    if sf.ann.at(tgt.lineno, "unguarded-ok") is not None:
+                        info.exempt.add(attr)
+    # Pass 2: access scan with lock tracking.
+    for m in methods:
+        base: Set[str] = set()
+        held = sf.ann.at(m.lineno, "holds-lock")
+        if held:
+            base.update(a.strip() for a in held.split(",") if a.strip())
+        if m.name.endswith("_locked"):
+            base.update(info.lock_attrs)
+        scan = _MethodScan(info, m.name, base)
+        for stmt in m.body:
+            scan.visit(stmt)
+    return info
+
+
+def _check_class(sf: SourceFile, info: _ClassInfo) -> List[Finding]:
+    out: List[Finding] = []
+    has_concurrency = bool(info.lock_attrs) or info.spawns_threads
+    for attr, accs in sorted(info.accesses.items()):
+        if attr in info.lock_attrs or attr in info.exempt:
+            continue
+        symbol = f"{info.name}.{attr}"
+        writes = [a for a in accs if a.kind in ("write", "aug", "mutate")]
+        eff_writes = [w for w in writes if w.method not in _INIT_METHODS]
+        guarded = [w for w in eff_writes if w.locks]
+        unguarded = [w for w in eff_writes if not w.locks]
+
+        decl = info.declared.get(attr)
+        if decl is not None:
+            lock, _ = decl
+            bad = [w for w in eff_writes if lock not in w.locks]
+            if bad:
+                w = bad[0]
+                out.append(Finding(
+                    "DC102", sf.path, w.line, symbol,
+                    f"{symbol} is declared guarded-by({lock}) but "
+                    f"{w.method}() writes it without holding self.{lock}",
+                ))
+            continue  # an explicit declaration supersedes inference
+
+        if guarded and unguarded:
+            w = unguarded[0]
+            locks = ", ".join(sorted({l for g in guarded for l in g.locks}))
+            out.append(Finding(
+                "DC100", sf.path, w.line, symbol,
+                f"{symbol} is written under self.{locks} elsewhere but "
+                f"{w.method}() writes it with no lock held — the guard is "
+                "advisory; annotate guarded-by/unguarded-ok or take the lock",
+            ))
+            continue
+
+        entry_writes = [
+            w for w in unguarded if w.method in info.thread_entries
+        ]
+        if entry_writes:
+            others = {
+                a.method for a in accs
+                if a.method not in _INIT_METHODS
+                and a.method != entry_writes[0].method
+            }
+            if others:
+                w = entry_writes[0]
+                out.append(Finding(
+                    "DC101", sf.path, w.line, symbol,
+                    f"{symbol} is written without a lock in thread-entry "
+                    f"method {w.method}() and also touched by "
+                    f"{', '.join(sorted(others))} — cross-thread access "
+                    "needs a lock or an unguarded-ok(reason) annotation",
+                ))
+                continue
+
+        if has_concurrency:
+            augs = [w for w in unguarded if w.kind == "aug"]
+            if augs:
+                w = augs[0]
+                out.append(Finding(
+                    "DC103", sf.path, w.line, symbol,
+                    f"non-atomic read-modify-write of {symbol} in "
+                    f"{w.method}() with no lock held, in a class that owns "
+                    "locks/threads — concurrent updates lose increments",
+                ))
+    return out
+
+
+def _skip(path: str) -> bool:
+    parts = path.split("/")
+    return any(seg in _SKIP_SEGMENTS for seg in parts[:-1])
+
+
+@register
+def check(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        if _skip(sf.path):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(_check_class(sf, _scan_class(sf, node)))
+    return out

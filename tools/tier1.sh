@@ -33,6 +33,17 @@
 # every tier-1 pass (~45 s of the budget on CPU).
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Hard gate: the distcheck static analyzer must be clean (modulo the
+# checked-in baseline) before any test runs.  Lock-discipline, event-loop
+# blocking calls, PRNG/host-sync hygiene, metrics-registry drift and
+# relay-frame schema drift all fail the tier here, cheaply, with a
+# path:line report — not minutes later as a flaky race in the suite.
+if ! python -m tools.distcheck distributed_llm_inference_tpu/; then
+    echo "tier1: distcheck gate FAILED (fix or baseline the findings)"
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
