@@ -534,6 +534,10 @@ def cmd_check(args) -> int:
     argv = list(args.paths)
     if args.no_baseline:
         argv.append("--no-baseline")
+    if args.json:
+        argv.append("--json")
+    if args.changed is not None:
+        argv.extend(["--changed", args.changed])
     return distcheck_main(argv)
 
 
@@ -764,6 +768,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "installed package)")
     k.add_argument("--no-baseline", action="store_true",
                    help="report baselined findings too")
+    k.add_argument("--json", action="store_true",
+                   help="machine-readable findings (JSON array)")
+    k.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="analyze only .py files changed vs a git ref "
+                        "(default HEAD)")
     k.set_defaults(fn=cmd_check)
     return p
 

@@ -116,14 +116,18 @@ class PrefillWorker:
                     header, _ = unpack_frame(frame)
                     op = header.get("op")
                 except Exception:
-                    continue  # malformed frame: drop, keep serving
+                    # Malformed frame: drop but count — the sender's request
+                    # is gone and only /metrics can say so.
+                    self.metrics.counter("malformed_frames")
+                    continue
                 if op == "shutdown":
-                    return
+                    return  # distcheck: reply-ok(shutdown frames are fire-and-forget)
                 if op != "prefill":
+                    self.metrics.counter("unknown_ops_dropped")
                     continue
                 reply = header.get("reply")
                 if not reply:
-                    continue  # nowhere to answer — drop
+                    continue  # distcheck: reply-ok(frame carries no reply address)
                 with self._busy_lock:
                     self._busy += 1
                 try:

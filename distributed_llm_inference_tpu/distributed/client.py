@@ -169,6 +169,9 @@ class DistributedClient:
 
     def plan_route(self) -> List[dict]:
         with self._dir_lock:
+            # The directory client owns one socket; the lock IS the
+            # serialization of that RPC — callers block behind it by design.
+            # distcheck: blocking-ok(single shared directory socket; the lock serializes the RPC)
             return self._directory.route(self.cfg.num_layers)
 
     def _bucket(self, n: int) -> int:

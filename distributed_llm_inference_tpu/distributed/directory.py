@@ -149,26 +149,32 @@ class BlockDirectory:
         if span is not None and span < 1:
             raise ValueError(f"span must be positive, got {span}")
         span = min(span or num_layers, num_layers)
-        cov = [0] * num_layers
-        for n in self.alive():
-            for layer in range(n.first_layer, min(n.last_layer + 1,
-                                                  num_layers)):
-                cov[layer] += 1
-        if 0 in cov:
-            # Start AT the gap (moving the range to fit a full span would
-            # drift away from it); a tail gap simply yields a shorter range.
-            first = cov.index(0)
-            last = min(first + span, num_layers) - 1
-        else:
-            sums = [
-                sum(cov[i : i + span])
-                for i in range(num_layers - span + 1)
-            ]
-            first = min(range(len(sums)), key=sums.__getitem__)
-            last = first + span - 1
-        if reserve_ttl:
-            rid = f"reserved-{uuid.uuid4().hex[:8]}"
-            with self._lock:
+        # Coverage read and reservation insert form ONE atomic step: two
+        # joiners racing between an unlocked snapshot and the reserve would
+        # both see the same hole, adopt it, and later collide in register()
+        # while another hole stays open.
+        with self._lock:
+            self._expire_locked()
+            cov = [0] * num_layers
+            for n in self._nodes.values():
+                for layer in range(n.first_layer, min(n.last_layer + 1,
+                                                      num_layers)):
+                    cov[layer] += 1
+            if 0 in cov:
+                # Start AT the gap (moving the range to fit a full span
+                # would drift away from it); a tail gap simply yields a
+                # shorter range.
+                first = cov.index(0)
+                last = min(first + span, num_layers) - 1
+            else:
+                sums = [
+                    sum(cov[i : i + span])
+                    for i in range(num_layers - span + 1)
+                ]
+                first = min(range(len(sums)), key=sums.__getitem__)
+                last = first + span - 1
+            if reserve_ttl:
+                rid = f"reserved-{uuid.uuid4().hex[:8]}"
                 self._nodes[rid] = NodeInfo(
                     rid, first, last, queue="",
                     lease_expiry=time.monotonic() + reserve_ttl,
@@ -225,13 +231,13 @@ class DirectoryService:
                 req = json.loads(frame)
                 reply_to = req["reply_to"]
             except (ValueError, KeyError, TypeError):
-                continue
+                continue  # distcheck: reply-ok(malformed frame has no reply address)
             reply = self._handle(req)
             reply["rid"] = req.get("rid")
             try:
                 self._client.put(reply_to, json.dumps(reply).encode())
             except (ConnectionError, OSError):
-                return
+                return  # distcheck: reply-ok(no transport left to reply over)
 
     def _handle(self, req: dict) -> dict:
         d = self.directory

@@ -157,16 +157,20 @@ class ServingNode:
                 header, arr = unpack_frame(frame)
                 op = header.get("op")
                 if op == "shutdown":
-                    return
+                    return  # distcheck: reply-ok(shutdown frames are fire-and-forget)
                 if op == "end":
                     # Through the pool so backend state stays single-threaded.
                     self._pool.submit((("end",), header, None),
                                       eager=bool(header.get("gens")))
                     continue
                 if op != "forward":
+                    # An op this node doesn't speak: the drop must at least
+                    # be visible on /metrics, or a protocol skew between
+                    # client and worker looks like silent request loss.
+                    self.metrics.counter("unknown_ops_dropped")
                     continue
                 if not header.get("hops"):
-                    continue  # nowhere to reply or report to — drop
+                    continue  # distcheck: reply-ok(frame carries no reply address)
                 # Group key: hops of equal padded length batch together
                 # (decode steps with decode steps, like-bucketed prefills
                 # with each other). Stacked multi-generation frames
@@ -184,7 +188,8 @@ class ServingNode:
                 self._pool.submit((("fwd", s_key), header, arr),
                                   eager=bool(header.get("gens")))
         except (ConnectionError, OSError):
-            return  # relay gone: health loop will notice / tests tear down
+            # Relay gone: health loop notices / tests tear down.
+            return  # distcheck: reply-ok(no transport left to reply over)
         except Exception:
             # Record the real cause here, where the exception is live — the
             # watchdog thread only sees that the loop died.

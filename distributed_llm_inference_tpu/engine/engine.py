@@ -1387,23 +1387,31 @@ class InferenceEngine:
                 if need > self.allocator.free_count:
                     return None  # pool pressure: same signal as a full batch
                 s.pages = self.allocator.alloc(need)
-                for i, pg in enumerate(s.pages):
-                    self._queue_install(slot, i, pg)
-                self._flush_installs()  # the ingest scatter reads the table
-                sub = self.cache.select_row(slot)
-                if quant:
-                    sub = sub.ingest_planes_row(
-                        dev["k"], dev["v"], dev["ks"], dev["vs"], n
-                    )
-                else:
-                    sub = sub.ingest_row(dev["k"], dev["v"], n)
-                self.cache = self.cache.merge_row(sub, slot)
-                if self.ccfg.prefix_caching:
-                    # Imported prompt pages seed the prefix cache exactly
-                    # like locally prefilled ones.
-                    s.prefix_keys = PageAllocator.chain_keys(prompt, ps)
-                    for i, key in enumerate(s.prefix_keys):
-                        self.allocator.register(s.pages[i], key)
+                try:
+                    for i, pg in enumerate(s.pages):
+                        self._queue_install(slot, i, pg)
+                    self._flush_installs()  # the ingest scatter reads the table
+                    sub = self.cache.select_row(slot)
+                    if quant:
+                        sub = sub.ingest_planes_row(
+                            dev["k"], dev["v"], dev["ks"], dev["vs"], n
+                        )
+                    else:
+                        sub = sub.ingest_row(dev["k"], dev["v"], n)
+                    self.cache = self.cache.merge_row(sub, slot)
+                    if self.ccfg.prefix_caching:
+                        # Imported prompt pages seed the prefix cache exactly
+                        # like locally prefilled ones.
+                        s.prefix_keys = PageAllocator.chain_keys(prompt, ps)
+                        for i, key in enumerate(s.prefix_keys):
+                            self.allocator.register(s.pages[i], key)
+                except BaseException:
+                    # The session was never published — nothing else frees
+                    # these pages if the ingest/prefix path raises.
+                    self.allocator.free(s.pages)
+                    s.pages = []
+                    s.prefix_keys = []
+                    raise
             else:
                 sub = self.cache.select_row(slot)
                 if quant:

@@ -73,6 +73,7 @@ METRICS = {
     "client_batch_group": ("summary", "generate_many co-batch group size"),
     "client_generate_errors": ("counter", "Client-side generate failures"),
     "malformed_frames": ("counter", "Frames dropped by schema checks"),
+    "unknown_ops_dropped": ("counter", "Frames dropped for an unknown op"),
     "duplicate_hops_skipped": ("counter", "At-most-once hop dedup skips"),
     "worker_restarts": ("counter", "Consume-thread watchdog restarts"),
     "pool_batch_occupancy": ("summary", "Items per task-pool device call"),

@@ -1,0 +1,48 @@
+"""Annotated twin of ``lifecycle_violation.py`` — expects NO findings.
+
+The exception paths release before escaping (``except``/``finally``),
+and the deliberate process-lifetime connection carries ``leak-ok``.
+"""
+
+from distributed_llm_inference_tpu.distributed.relay import RelayClient
+
+
+class Session:
+    def __init__(self):
+        self.pages = []
+
+
+class Importer:
+    def __init__(self, allocator, registry):
+        self.allocator = allocator
+        self.registry = registry
+
+    def admit(self, n, planes):
+        s = Session()
+        s.pages = self.allocator.alloc(n)
+        try:
+            self.ingest(planes)
+        except Exception:
+            self.allocator.free(s.pages)
+            raise
+        self.registry[id(s)] = s
+        return s
+
+    def ingest(self, planes):
+        if not planes:
+            raise ValueError("empty planes")
+
+
+def fetch(host, port, queue):
+    client = RelayClient(host, port)
+    try:
+        return client.get(queue, timeout=1.0)
+    finally:
+        client.close()
+
+
+def open_probe(host, port):
+    # distcheck: leak-ok(probe connection is process-lifetime by design)
+    client = RelayClient(host, port)
+    client.ping()
+    return client

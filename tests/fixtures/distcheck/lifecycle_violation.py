@@ -1,0 +1,46 @@
+"""Seeded resource-lifecycle violations — distcheck fixture.
+
+Expected findings:
+  DC120 x2  (leaked pages on an exception path, leaked relay connection)
+  DC121 x1  (double-close on one straight-line path)
+"""
+
+from distributed_llm_inference_tpu.distributed.relay import RelayClient
+
+
+class Session:
+    def __init__(self):
+        self.pages = []
+
+
+class Importer:
+    def __init__(self, allocator, registry):
+        self.allocator = allocator
+        self.registry = registry
+
+    def admit(self, n, planes):
+        s = Session()
+        s.pages = self.allocator.alloc(n)  # DC120: ingest below may raise
+        self.ingest(planes)  # raises before the session is published
+        self.registry[id(s)] = s
+        return s
+
+    def ingest(self, planes):
+        if not planes:
+            raise ValueError("empty planes")
+
+
+def fetch(host, port, queue):
+    client = RelayClient(host, port)  # DC120: get may raise, no finally
+    frame = client.get(queue, timeout=1.0)
+    client.close()
+    return frame
+
+
+def fetch_twice(host, port, queue):
+    client = RelayClient(host, port)
+    try:
+        return client.get(queue, timeout=1.0)
+    finally:
+        client.close()
+        client.close()  # DC121: double-close

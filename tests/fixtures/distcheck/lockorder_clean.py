@@ -1,0 +1,46 @@
+"""Annotated twin of ``lockorder_violation.py`` — expects NO findings.
+
+Same shapes: the nesting follows one global order everywhere, and the
+deliberate blocking calls under a lock carry ``blocking-ok`` reasons.
+"""
+
+import threading
+import time
+
+
+class Ordered:
+    """Both methods nest the pair the same way round."""
+
+    def __init__(self):
+        self._a = threading.Lock()  # distcheck: lock-order(_a<_b)
+        self._b = threading.Lock()
+        self.items = []
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+
+class Holder:
+    """Bounded blocking under the lock, annotated with the reason."""
+
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+
+    def direct(self):
+        with self._lock:
+            time.sleep(0.01)  # distcheck: blocking-ok(10 ms calibration pause, bounded)
+
+    def _flush(self):
+        self.sock.sendall(b"x")
+
+    def indirect(self):
+        with self._lock:
+            self._flush()  # distcheck: blocking-ok(single bounded frame, peer is local)

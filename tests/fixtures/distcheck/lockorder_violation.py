@@ -1,0 +1,60 @@
+"""Seeded lock-order / lock-hold violations — distcheck fixture.
+
+Expected findings:
+  DC110 x2  (one acquisition cycle, one declared-order contradiction)
+  DC111 x2  (one direct blocking call under a lock, one through a callee)
+"""
+
+import threading
+import time
+
+
+class Inverted:
+    """Two methods nest the same pair of locks in opposite orders."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.items = []
+
+    def forward(self):
+        with self._a:
+            with self._b:  # edge _a -> _b
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:  # DC110: closes the cycle _a -> _b -> _a
+                pass
+
+
+class Declared:
+    """A nesting that contradicts the documented global order."""
+
+    def __init__(self):
+        self._m = threading.Lock()  # distcheck: lock-order(_m<_n)
+        self._n = threading.Lock()
+
+    def bad(self):
+        with self._n:
+            with self._m:  # DC110: contradicts lock-order(_m<_n)
+                pass
+
+
+class Holder:
+    """Blocking work inside the critical section."""
+
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+
+    def direct(self):
+        with self._lock:
+            time.sleep(0.5)  # DC111: sleeps while holding _lock
+
+    def _flush(self):
+        self.sock.sendall(b"x")
+
+    def indirect(self):
+        with self._lock:
+            self._flush()  # DC111: reaches a socket send under _lock

@@ -1,0 +1,30 @@
+"""Annotated twin of ``reply_violation.py`` — expects NO findings.
+
+The shutdown exit is declared fire-and-forget with ``reply-ok``; the
+unknown-op drop bumps a declared error counter before bailing.
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import unpack_frame
+
+
+class Node:
+    def __init__(self, relay, pool, metrics):
+        self.relay = relay
+        self._pool = pool
+        self.metrics = metrics
+        self._stopped = False
+
+    def _consume(self):
+        while not self._stopped:
+            try:
+                frame = self.relay.get("work", timeout=0.5)
+            except TimeoutError:
+                continue  # nothing consumed yet: exempt
+            header, arr = unpack_frame(frame)
+            op = header.get("op")
+            if op == "stop":
+                return  # distcheck: reply-ok(shutdown frames are fire-and-forget)
+            if op != "forward":
+                self.metrics.counter("unknown_ops_dropped")
+                continue  # counted: the drop is observable
+            self._pool.submit((header, arr))

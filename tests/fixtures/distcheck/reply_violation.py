@@ -1,0 +1,28 @@
+"""Seeded reply-guarantee violations — distcheck fixture.
+
+Expected findings:
+  DC130 x2  (silent bare return and silent continue after the decode)
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import unpack_frame
+
+
+class Node:
+    def __init__(self, relay, pool):
+        self.relay = relay
+        self._pool = pool
+        self._stopped = False
+
+    def _consume(self):
+        while not self._stopped:
+            try:
+                frame = self.relay.get("work", timeout=0.5)
+            except TimeoutError:
+                continue  # nothing consumed yet: exempt
+            header, arr = unpack_frame(frame)
+            op = header.get("op")
+            if op == "stop":
+                return  # DC130: request consumed, requester never hears back
+            if op != "forward":
+                continue  # DC130: unknown op dropped with no reply or counter
+            self._pool.submit((header, arr))

@@ -343,6 +343,33 @@ def test_disagg_rejects_sink_cache():
         eng.admit_prefilled(prompt, planes, first, options=opts)
 
 
+def test_disagg_admit_failure_frees_pages():
+    """A failure between page allocation and session publication inside
+    admit_prefilled must return the pages to the pool — the session was
+    never published, so nothing else will (DC120 regression)."""
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    opts = SamplingOptions(max_new_tokens=4)
+    src, dst = make_engine("paged"), make_engine("paged")
+    planes, first, _ = src.prefill_export(prompt, opts)
+    free0 = dst.allocator.free_count
+
+    def explode():
+        raise RuntimeError("injected ingest failure")
+
+    orig = dst._flush_installs
+    dst._flush_installs = explode
+    try:
+        with pytest.raises(RuntimeError, match="injected ingest"):
+            dst.admit_prefilled(prompt, planes, first, options=opts)
+    finally:
+        dst._flush_installs = orig
+    assert dst.allocator.free_count == free0  # every page reclaimed
+    assert not dst.sessions  # nothing half-admitted
+    # The pool is intact: the same admission now succeeds end to end.
+    gid = dst.admit_prefilled(prompt, planes, first, options=opts)
+    assert gid is not None
+
+
 def test_disagg_admit_overlaps_inflight_decode():
     """admit_prefilled lands on the PR-4 deferred path when a decode tick
     is in flight — and the stream is still byte-exact."""

@@ -92,6 +92,17 @@ _EXPECTED = {
         "DC500": 1,  # consumer reads 'seqno' no producer writes
         "DC501": 1,  # producer writes 'ttl_hint' no consumer reads
     },
+    "lockorder_violation.py": {
+        "DC110": 2,  # inverted nesting cycle; declared-order contradiction
+        "DC111": 2,  # sleep under lock; socket send via resolved callee
+    },
+    "lifecycle_violation.py": {
+        "DC120": 2,  # page alloc leak; relay connection leak
+        "DC121": 1,  # double-close on one straight-line path
+    },
+    "reply_violation.py": {
+        "DC130": 2,  # silent bare return; silent continue, both post-decode
+    },
 }
 
 
@@ -113,6 +124,9 @@ _CLEAN = [
     "jax_clean.py",
     "metrics_clean.py",
     "frames_clean.py",
+    "lockorder_clean.py",
+    "lifecycle_clean.py",
+    "reply_clean.py",
 ]
 
 
@@ -153,6 +167,125 @@ def test_ignore_pragma_suppresses_single_check(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# call graph (core.CallGraph): resolution rules + depth limit
+# ---------------------------------------------------------------------------
+
+
+def _graph_of(tmp_path, sources):
+    for name, text in sources.items():
+        (tmp_path / name).write_text(text)
+    files, errors = core.collect_files(
+        [str(tmp_path / n) for n in sorted(sources)]
+    )
+    assert not errors, errors
+    return core.CallGraph(files), {f.path.rsplit("/", 1)[-1]: f for f in files}
+
+
+def _call_in(fi):
+    import ast
+
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call):
+            return node
+    raise AssertionError(f"no call in {fi.qualname}")
+
+
+def test_callgraph_method_vs_function_disambiguation(tmp_path):
+    """``self.ping()`` resolves to the enclosing class's method even when a
+    module function shares the name; a bare ``ping()`` resolves to the
+    module function, never a method."""
+    graph, by_name = _graph_of(tmp_path, {"mod.py": (
+        "def ping():\n"
+        "    return 'module'\n"
+        "\n"
+        "class Svc:\n"
+        "    def ping(self):\n"
+        "        return 'method'\n"
+        "\n"
+        "    def via_self(self):\n"
+        "        return self.ping()\n"
+        "\n"
+        "    def via_bare(self):\n"
+        "        return ping()\n"
+    )})
+    sf = by_name["mod.py"]
+    via_self = graph.method(sf, "Svc", "via_self")
+    got = graph.resolve_call(sf, _call_in(via_self), "Svc")
+    assert got is not None and got.cls == "Svc" and got.name == "ping"
+    via_bare = graph.method(sf, "Svc", "via_bare")
+    got = graph.resolve_call(sf, _call_in(via_bare), "Svc")
+    assert got is not None and got.cls is None and got.name == "ping"
+
+
+def test_callgraph_resolves_from_import_alias(tmp_path):
+    graph, by_name = _graph_of(tmp_path, {
+        "helpers.py": "def pack(x):\n    return x\n",
+        "main.py": (
+            "from .helpers import pack\n"
+            "\n"
+            "def go(v):\n"
+            "    return pack(v)\n"
+        ),
+    })
+    sf = by_name["main.py"]
+    go = graph.module_function(sf, "go")
+    got = graph.resolve_call(sf, _call_in(go))
+    assert got is not None and got.name == "pack"
+    assert got.sf.path.endswith("helpers.py")
+
+
+def test_callgraph_iter_calls_respects_depth_limit(tmp_path):
+    chain = (
+        "def a():\n    b()\n"
+        "def b():\n    c()\n"
+        "def c():\n    d()\n"
+        "def d():\n    e()\n"
+        "def e():\n    pass\n"
+    )
+    graph, by_name = _graph_of(tmp_path, {"chain.py": chain})
+    sf = by_name["chain.py"]
+    a = graph.module_function(sf, "a")
+
+    def callers(max_depth):
+        return {
+            cur.name for cur, _, _, _ in graph.iter_calls(a, max_depth)
+        }
+
+    assert callers(1) == {"a"}          # only the root's own call sites
+    assert callers(3) == {"a", "b", "c"}
+    assert callers(10) == {"a", "b", "c", "d"}  # e has no calls to yield
+
+
+def test_callgraph_iter_calls_is_cycle_safe(tmp_path):
+    graph, by_name = _graph_of(tmp_path, {"cyc.py": (
+        "def f():\n    g()\n"
+        "def g():\n    f()\n"
+    )})
+    sf = by_name["cyc.py"]
+    f = graph.module_function(sf, "f")
+    sites = list(graph.iter_calls(f, 50))  # must terminate
+    assert {cur.name for cur, _, _, _ in sites} == {"f", "g"}
+
+
+def test_callgraph_ambient_attrs_stay_unresolved(tmp_path):
+    """Generic verbs (``.get``, ``.close``, ...) never resolve to some
+    arbitrary same-named method elsewhere in the package."""
+    import ast
+
+    graph, by_name = _graph_of(tmp_path, {"amb.py": (
+        "class Store:\n"
+        "    def get(self, k):\n"
+        "        return k\n"
+        "\n"
+        "def use(d):\n"
+        "    return d.get('x')\n"
+    )})
+    sf = by_name["amb.py"]
+    use = graph.module_function(sf, "use")
+    assert graph.resolve_call(sf, _call_in(use)) is None
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -180,3 +313,116 @@ def test_distribute_check_subcommand():
     rc = cli.main(["check", "--no-baseline",
                    str(FIXTURES / "async_violation.py")])
     assert rc == 1
+
+
+def test_distribute_check_json_passthrough(capsys):
+    import json
+
+    from distributed_llm_inference_tpu import cli
+
+    rc = cli.main(["check", "--no-baseline", "--json",
+                   str(FIXTURES / "reply_violation.py")])
+    assert rc == 1
+    docs = json.loads(capsys.readouterr().out)
+    assert {d["id"] for d in docs} == {"DC130"}
+
+
+def test_json_output_shape():
+    """--json: a parseable array of objects with the documented fields."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.distcheck", "--json", "--no-baseline",
+         str(FIXTURES / "lifecycle_violation.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    docs = json.loads(proc.stdout)
+    assert sorted(d["id"] for d in docs) == ["DC120", "DC120", "DC121"]
+    for d in docs:
+        assert set(d) == {
+            "path", "line", "id", "symbol", "message", "fingerprint"
+        }
+        assert d["fingerprint"].startswith(d["id"] + " ")
+        assert str(d["line"]) not in d["fingerprint"]  # line-number free
+
+
+def test_changed_mode_reports_no_files_cleanly():
+    """--changed vs HEAD in a clean tree: nothing to analyze, exit 0."""
+    dirty = subprocess.run(
+        ["git", "status", "--porcelain"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    ).stdout.strip()
+    if dirty:
+        pytest.skip("working tree not clean; --changed set is unstable")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.distcheck", "--changed", "HEAD",
+         str(PACKAGE)],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_changed_mode_analyzes_given_ref(tmp_path):
+    """--changed runs the per-function checkers over the changed subset
+    (whole-program checkers stay conservatively silent there)."""
+    from tools.distcheck.__main__ import changed_files
+
+    files = changed_files("HEAD", [str(REPO_ROOT)])
+    assert isinstance(files, list)  # resolvable ref, no crash
+    findings, errors = core.analyze(files) if files else ([], [])
+    assert not errors
+
+
+def test_stale_baseline_entry_warns_but_passes(tmp_path):
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("DC999 nonexistent/file.py ghost.symbol\n")
+    buf = io.StringIO()
+    rc = core.run(
+        [str(FIXTURES / "locks_clean.py")], baseline=baseline, out=buf
+    )
+    assert rc == 0
+    assert "stale baseline entry" in buf.getvalue()
+
+
+def test_stale_baseline_entry_fails_under_strict(tmp_path):
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("DC999 nonexistent/file.py ghost.symbol\n")
+    buf = io.StringIO()
+    rc = core.run(
+        [str(FIXTURES / "locks_clean.py")], baseline=baseline, out=buf,
+        strict_baseline=True,
+    )
+    assert rc == 1
+    assert "stale baseline entry" in buf.getvalue()
+
+
+def test_timings_line_covers_every_checker():
+    buf = io.StringIO()
+    core.run([str(FIXTURES / "locks_clean.py")], baseline=None, out=buf,
+             timings=True)
+    line = next(
+        l for l in buf.getvalue().splitlines() if "timings:" in l
+    )
+    for checker in ("locks", "lockorder", "lifecycle", "reply", "frames",
+                    "metriclint", "jaxlint", "asynclint"):
+        assert f"{checker}=" in line, line
+
+
+def test_subset_scan_silences_closed_world_checks():
+    """A subset containing the metrics registry but not its emitters must
+    not flood DC401 in --changed mode."""
+    buf = io.StringIO()
+    rc = core.run(
+        [str(PACKAGE / "utils" / "metrics.py"),
+         str(PACKAGE / "distributed" / "worker.py")],
+        baseline=None, out=buf, subset=True,
+    )
+    assert rc == 0, buf.getvalue()
+    # The same subset scanned as a closed world DOES report dead entries —
+    # proving subset mode, not checker blindness, is what silenced them.
+    findings, _ = core.analyze(
+        [str(PACKAGE / "utils" / "metrics.py"),
+         str(PACKAGE / "distributed" / "worker.py")]
+    )
+    assert any(f.check_id == "DC401" for f in findings)

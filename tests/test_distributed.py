@@ -216,6 +216,25 @@ def test_unknown_session_error_reaches_client(cluster, params):
     assert "ghost" in reply["error"]
 
 
+def test_unknown_op_drop_is_counted(cluster, params):
+    """A frame with an op the worker doesn't speak is dropped but counted —
+    protocol skew shows on /metrics instead of looking like request loss."""
+    from distributed_llm_inference_tpu.distributed.messages import pack_frame
+    from distributed_llm_inference_tpu.distributed.relay import RelayClient
+
+    relay, _, n1, _ = cluster
+    with RelayClient(port=relay.port) as c:
+        header = {"op": "bogus", "hops": ["reply.nowhere"]}
+        x = np.zeros((1, 1, CFG.hidden_size), np.float32)
+        c.put(n1.queue, pack_frame(header, x))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if n1.metrics.get_counter("unknown_ops_dropped") >= 1:
+            break
+        time.sleep(0.05)
+    assert n1.metrics.get_counter("unknown_ops_dropped") >= 1
+
+
 def test_midstream_node_death_reroute_and_replay(cluster, params):
     """SURVEY §5.3: a node dies MID-generation; a replacement registers; the
     client re-routes and replays, and the final stream is identical to an

@@ -3,17 +3,49 @@
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
 from .core import DEFAULT_BASELINE, REPO_ROOT, run
 
 
+def changed_files(ref: str, roots) -> list:
+    """``.py`` files changed vs ``ref`` (diff + untracked), restricted to
+    the requested analysis roots."""
+    out: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only", ref, "--", "*.py"],
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=REPO_ROOT, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"distcheck: --changed: {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip()}"
+            )
+        out.update(l.strip() for l in proc.stdout.splitlines() if l.strip())
+    resolved = []
+    root_paths = [Path(r).resolve() for r in roots]
+    for rel in sorted(out):
+        p = (REPO_ROOT / rel).resolve()
+        if not p.is_file():
+            continue  # deleted in the diff
+        for r in root_paths:
+            if p == r or (r.is_dir() and str(p).startswith(str(r) + "/")):
+                resolved.append(str(p))
+                break
+    return resolved
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="distcheck",
         description="Project-invariant static analyzer (lock discipline, "
-        "async blocking calls, PRNG/host-sync hygiene, metrics registry, "
+        "lock ordering, async blocking calls, resource lifecycle, reply "
+        "guarantees, PRNG/host-sync hygiene, metrics registry, "
         "relay-frame schema).",
     )
     ap.add_argument(
@@ -30,9 +62,43 @@ def main(argv=None) -> int:
         "--no-baseline", action="store_true",
         help="report baselined findings too",
     )
+    ap.add_argument(
+        "--strict-baseline", action="store_true",
+        help="stale baseline entries (matching no finding) are an error, "
+        "not a warning",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="json_out",
+        help="machine-readable output: a JSON array of findings "
+        "(path, line, id, symbol, message, fingerprint)",
+    )
+    ap.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="analyze only .py files changed vs a git ref (default HEAD). "
+        "Whole-program checkers stay conservatively silent on subsets — "
+        "this is the fast pre-commit loop, not the tier-1 gate",
+    )
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="print per-checker wall time (the tier-1 budget line)",
+    )
     args = ap.parse_args(argv)
+    paths = args.paths
+    subset = args.changed is not None
+    if subset:
+        paths = changed_files(args.changed, paths)
+        if not paths:
+            print(f"distcheck: no changed .py files vs {args.changed}")
+            return 0
     baseline = None if args.no_baseline else args.baseline
-    return run(args.paths, baseline=baseline)
+    return run(
+        paths,
+        baseline=baseline,
+        json_out=args.json_out,
+        strict_baseline=args.strict_baseline,
+        timings=args.timings,
+        subset=subset,
+    )
 
 
 if __name__ == "__main__":

@@ -17,6 +17,9 @@ directly above it)::
     # distcheck: host-sync-ok(reason)      tick-path host sync is budgeted
     # distcheck: key-reuse-ok(reason)      PRNG key reuse is intended
     # distcheck: metric(name_a, name_b)    names a computed metric resolves to
+    # distcheck: lock-order(_a<_b)         declare the intended lock order
+    # distcheck: leak-ok(reason)           resource escape is intended
+    # distcheck: reply-ok(reason)          consumer exit w/o reply is intended
     # distcheck: ignore[DC###](reason)     suppress one check on this line
 
 Findings print as ``path:line CHECK-ID message``. ``baseline.txt`` (next
@@ -194,9 +197,244 @@ def self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
+# -- whole-program call graph ------------------------------------------------
+#
+# The one-deep dict/call resolution that used to live privately inside
+# frames.py, lifted into a package-wide service every checker consumes:
+# def→callsite edges with method resolution through ``self.`` and module
+# attributes, plus a configurable traversal depth.  Resolution is
+# deliberately conservative:
+#
+# * ``self.m(...)`` resolves to method ``m`` of the *enclosing class*
+#   (beating any same-named module function — methods and functions are
+#   different namespaces);
+# * a bare ``f(...)`` resolves to a module-level function (same module
+#   first, then a ``from x import f`` alias) and NEVER to a method;
+# * ``alias.f(...)`` resolves through an imported sibling module;
+# * ``obj.m(...)`` on an arbitrary receiver resolves only when exactly
+#   one class in the scanned set defines ``m`` and the name is not one of
+#   the generic stdlib-ish verbs in :data:`_AMBIENT_ATTRS` — anything
+#   else stays unresolved rather than guessed.
+
+
+# Attribute names too generic to resolve by global uniqueness: builtin
+# container verbs, file/socket verbs, names shared with the stdlib.
+_AMBIENT_ATTRS = {
+    "append", "extend", "insert", "pop", "remove", "add", "discard",
+    "clear", "update", "setdefault", "get", "put", "items", "keys",
+    "values", "copy", "sort", "index", "count", "join", "split", "strip",
+    "encode", "decode", "read", "write", "close", "open", "send", "recv",
+    "start", "stop", "run", "result", "set", "wait", "notify", "acquire",
+    "release", "submit", "cancel", "flush", "info", "debug", "warning",
+    "error", "exception", "format", "replace",
+}
+
+DEFAULT_CALL_DEPTH = 3
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method definition in the scanned set."""
+
+    sf: SourceFile
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    name: str
+    cls: Optional[str]  # enclosing class name, None for module level
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    def params(self) -> List[str]:
+        return [a.arg for a in self.node.args.args]
+
+    def param_for_arg(self, pos: int) -> Optional[str]:
+        """Parameter name bound to positional arg ``pos`` at a call site
+        (accounting for the implicit ``self`` slot of methods)."""
+        params = self.params()
+        if params and params[0] in ("self", "cls"):
+            pos += 1
+        return params[pos] if pos < len(params) else None
+
+
+class CallGraph:
+    """Package-wide def→callsite resolution over a list of SourceFiles."""
+
+    def __init__(
+        self, files: Sequence[SourceFile], max_depth: int = DEFAULT_CALL_DEPTH
+    ):
+        self.max_depth = max_depth
+        self._module_fns: Dict[str, Dict[str, FunctionInfo]] = {}
+        self._methods: Dict[Tuple[str, str], Dict[str, FunctionInfo]] = {}
+        self._any_def: Dict[str, Dict[str, FunctionInfo]] = {}
+        self._by_method_name: Dict[str, List[FunctionInfo]] = {}
+        self._mod_alias: Dict[str, Dict[str, str]] = {}  # path -> alias -> path
+        self._fn_alias: Dict[str, Dict[str, FunctionInfo]] = {}
+        by_modname: Dict[str, str] = {}  # dotted module name -> path
+        for sf in files:
+            stem = sf.path[:-3] if sf.path.endswith(".py") else sf.path
+            by_modname[stem.replace("/", ".")] = sf.path
+        for sf in files:
+            mod = self._module_fns.setdefault(sf.path, {})
+            anyd = self._any_def.setdefault(sf.path, {})
+            for node in sf.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FunctionInfo(sf, node, node.name, None)
+                    mod.setdefault(node.name, fi)
+                elif isinstance(node, ast.ClassDef):
+                    tbl = self._methods.setdefault((sf.path, node.name), {})
+                    for sub in node.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fi = FunctionInfo(sf, sub, sub.name, node.name)
+                            tbl.setdefault(sub.name, fi)
+                            self._by_method_name.setdefault(
+                                sub.name, []
+                            ).append(fi)
+            # frames.py's historic table: first def of a name anywhere in
+            # the module, methods included (file order).
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    anyd.setdefault(
+                        node.name, FunctionInfo(sf, node, node.name, None)
+                    )
+            self._scan_imports(sf, by_modname)
+
+    def _scan_imports(self, sf: SourceFile, by_modname: Dict[str, str]):
+        """Map import aliases to scanned modules / module-level functions."""
+        pkg_parts = sf.path.split("/")[:-1]
+        aliases = self._mod_alias.setdefault(sf.path, {})
+        fn_aliases = self._fn_alias.setdefault(sf.path, {})
+
+        def resolve_module(dotted_mod: str) -> Optional[str]:
+            return by_modname.get(dotted_mod)
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    path = resolve_module(a.name)
+                    if path:
+                        aliases[a.asname or a.name.split(".")[-1]] = path
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative: from .x / from ..pkg.x
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    prefix = ".".join(base)
+                else:
+                    prefix = ""
+                mod = ".".join(p for p in (prefix, node.module or "") if p)
+                mod_path = resolve_module(mod)
+                for a in node.names:
+                    local = a.asname or a.name
+                    sub_path = resolve_module(f"{mod}.{a.name}" if mod else a.name)
+                    if sub_path:  # from pkg import module
+                        aliases[local] = sub_path
+                    elif mod_path:  # from module import fn
+                        fi = self._module_fns.get(mod_path, {}).get(a.name)
+                        if fi is not None:
+                            fn_aliases[local] = fi
+
+    # -- lookups --------------------------------------------------------------
+
+    def module_function(self, sf: SourceFile, name: str) -> Optional[FunctionInfo]:
+        return self._module_fns.get(sf.path, {}).get(name)
+
+    def method(
+        self, sf: SourceFile, cls: str, name: str
+    ) -> Optional[FunctionInfo]:
+        return self._methods.get((sf.path, cls), {}).get(name)
+
+    def any_def_in_module(self, path: str, name: str) -> Optional[FunctionInfo]:
+        """First def (function OR method) of ``name`` in module ``path`` —
+        frames.py's historic one-deep lookup semantics."""
+        return self._any_def.get(path, {}).get(name)
+
+    def resolve_call(
+        self, sf: SourceFile, call: ast.Call, cls: Optional[str] = None
+    ) -> Optional[FunctionInfo]:
+        """Best-effort resolution of a call site to its definition."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return (
+                self.module_function(sf, func.id)
+                or self._fn_alias.get(sf.path, {}).get(func.id)
+            )
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and cls is not None:
+                fi = self.method(sf, cls, attr)
+                if fi is not None:
+                    return fi
+            mod_path = self._mod_alias.get(sf.path, {}).get(recv.id)
+            if mod_path is not None:
+                target_mod = self._module_fns.get(mod_path, {})
+                return target_mod.get(attr)
+        if attr in _AMBIENT_ATTRS:
+            return None
+        candidates = self._by_method_name.get(attr, [])
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    def iter_calls(self, fn: FunctionInfo, max_depth: Optional[int] = None):
+        """Transitive DFS from ``fn``: yield ``(caller, call, callee, depth)``
+        for every call site reachable within ``max_depth`` hops (callee is
+        None for unresolved sites; unresolved sites end their branch).
+        Cycle-safe."""
+        limit = self.max_depth if max_depth is None else max_depth
+        seen = {id(fn.node)}
+        stack = [(fn, 1)]
+        while stack:
+            cur, depth = stack.pop()
+            for call in ast.walk(cur.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = self.resolve_call(cur.sf, call, cur.cls)
+                yield cur, call, callee, depth
+                if (
+                    callee is not None
+                    and depth < limit
+                    and id(callee.node) not in seen
+                ):
+                    seen.add(id(callee.node))
+                    stack.append((callee, depth + 1))
+
+
 # -- runner ------------------------------------------------------------------
 
 CHECKERS: List[Callable[[List[SourceFile]], List[Finding]]] = []
+
+# Per-checker wall time of the most recent analyze() pass, name -> seconds
+# (the tier-1 gate prints it so checker growth stays visible).
+LAST_TIMINGS: Dict[str, float] = {}
+
+_ACTIVE_GRAPH: Optional[Tuple[int, CallGraph]] = None
+
+# True while analyzing a subset of the package (``--changed`` mode):
+# closed-world checks (dead metric declarations, dead frame fields) must
+# stay silent — their "nobody uses this" evidence is the files NOT in
+# the scan.
+_SUBSET_SCAN = False
+
+
+def is_subset_scan() -> bool:
+    return _SUBSET_SCAN
+
+
+def graph_for(files: List[SourceFile]) -> CallGraph:
+    """The shared CallGraph for this file set (built once per analyze()
+    pass; every checker that needs interprocedural resolution calls
+    this instead of building its own tables)."""
+    global _ACTIVE_GRAPH
+    key = id(files)
+    if _ACTIVE_GRAPH is not None and _ACTIVE_GRAPH[0] == key:
+        return _ACTIVE_GRAPH[1]
+    graph = CallGraph(files)
+    _ACTIVE_GRAPH = (key, graph)
+    return graph
 
 
 def register(fn: Callable[[List[SourceFile]], List[Finding]]):
@@ -207,22 +445,39 @@ def register(fn: Callable[[List[SourceFile]], List[Finding]]):
 def _load_checkers() -> None:
     if CHECKERS:
         return
-    from . import asynclint, frames, jaxlint, locks, metriclint  # noqa: F401
+    from . import (  # noqa: F401
+        asynclint,
+        frames,
+        jaxlint,
+        lifecycle,
+        lockorder,
+        locks,
+        metriclint,
+        reply,
+    )
 
 
 def analyze(paths: Sequence[str]) -> Tuple[List[Finding], List[str]]:
     """Run every checker; returns (findings, parse_errors). Findings with a
     generic ``ignore[DC###]`` annotation are already dropped."""
+    import time
+
     _load_checkers()
     files, errors = collect_files(paths)
     by_path = {f.path: f for f in files}
     findings: List[Finding] = []
+    LAST_TIMINGS.clear()
     for check in CHECKERS:
+        t0 = time.perf_counter()
         for fd in check(files):
             sf = by_path.get(fd.path)
             if sf is not None and sf.ann.ignored(fd.line, fd.check_id):
                 continue
             findings.append(fd)
+        name = check.__module__.rsplit(".", 1)[-1]
+        LAST_TIMINGS[name] = LAST_TIMINGS.get(name, 0.0) + (
+            time.perf_counter() - t0
+        )
     findings.sort(key=lambda f: (f.path, f.line, f.check_id))
     return findings, errors
 
@@ -231,14 +486,27 @@ def run(
     paths: Sequence[str],
     baseline: Optional[Path] = DEFAULT_BASELINE,
     out=None,
+    json_out: bool = False,
+    strict_baseline: bool = False,
+    timings: bool = False,
+    subset: bool = False,
 ) -> int:
-    """CLI entry: print findings, return process exit code (0 = clean)."""
+    """CLI entry: print findings, return process exit code (0 = clean).
+
+    ``json_out`` emits one JSON object per unsuppressed finding instead of
+    the human report.  Baseline entries matching no current finding are
+    reported as stale (warning by default; exit 1 under
+    ``strict_baseline`` so the file can't silently rot)."""
+    import json as _json
     import sys
 
+    global _SUBSET_SCAN
     out = out or sys.stdout
-    findings, errors = analyze(paths)
-    for e in errors:
-        print(f"distcheck: parse error: {e}", file=out)
+    _SUBSET_SCAN = subset
+    try:
+        findings, errors = analyze(paths)
+    finally:
+        _SUBSET_SCAN = False
     base = load_baseline(baseline) if baseline else set()
     suppressed = 0
     shown: List[Finding] = []
@@ -247,10 +515,49 @@ def run(
             suppressed += 1
         else:
             shown.append(fd)
-    for fd in shown:
-        print(fd.render(), file=out)
-    tail = f"{len(shown)} finding(s)"
-    if suppressed:
-        tail += f", {suppressed} baselined"
-    print(f"distcheck: {tail} across {len(paths)} path(s)", file=out)
-    return 1 if (shown or errors) else 0
+    stale = sorted(base - {fd.fingerprint() for fd in findings})
+    if json_out:
+        print(_json.dumps([
+            {
+                "path": fd.path,
+                "line": fd.line,
+                "id": fd.check_id,
+                "symbol": fd.symbol,
+                "message": fd.message,
+                "fingerprint": fd.fingerprint(),
+            }
+            for fd in shown
+        ], indent=2), file=out)
+        diag = sys.stderr
+    else:
+        diag = out
+        for fd in shown:
+            print(fd.render(), file=out)
+    for e in errors:
+        print(f"distcheck: parse error: {e}", file=diag)
+    for fp in stale:
+        print(
+            f"distcheck: stale baseline entry (matches no finding): {fp}",
+            file=diag,
+        )
+    if timings:
+        parts = [f"{k}={v:.2f}s" for k, v in sorted(LAST_TIMINGS.items())]
+        total = sum(LAST_TIMINGS.values())
+        print(
+            f"distcheck: timings: {' '.join(parts)} total={total:.2f}s",
+            file=diag,
+        )
+    if not json_out:
+        tail = f"{len(shown)} finding(s)"
+        if suppressed:
+            tail += f", {suppressed} baselined"
+        if stale:
+            tail += f", {len(stale)} stale baseline entr" + (
+                "y" if len(stale) == 1 else "ies"
+            )
+        print(f"distcheck: {tail} across {len(paths)} path(s)", file=out)
+    if shown or errors:
+        return 1
+    if strict_baseline and stale:
+        return 1
+    return 0

@@ -27,7 +27,14 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, SourceFile, call_name, register
+from .core import (
+    Finding,
+    SourceFile,
+    call_name,
+    graph_for,
+    is_subset_scan,
+    register,
+)
 
 _PACKERS = {"pack_frame", "_pack"}
 _UNPACKERS = {"unpack_frame", "_unpack"}
@@ -228,20 +235,20 @@ def _scan_var_uses(
 
 @register
 def check(files: List[SourceFile]) -> List[Finding]:
+    if is_subset_scan():
+        # Schema drift is producer-set vs consumer-set evidence; a
+        # --changed subset sees neither side in full.
+        return []
     produced: Dict[str, Tuple[str, int]] = {}
     any_open_producer = False
     consumed: Dict[str, Tuple[str, int]] = {}
     wildcard_consumer = False
     n_producers = n_consumers = 0
 
-    # Per-module function table for one-deep interprocedural follow.
-    fn_tables: Dict[str, Dict[str, ast.AST]] = {}
-    for sf in files:
-        table: Dict[str, ast.AST] = {}
-        for node in ast.walk(sf.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                table.setdefault(node.name, node)
-        fn_tables[sf.path] = table
+    # Interprocedural follow rides the shared package-wide call graph
+    # (core.CallGraph): same-module def lookup, self-param offset, and the
+    # traversal depth cap all live there now.
+    graph = graph_for(files)
 
     def consume_via(
         sf: SourceFile, fn_node, var: str, mod_seqs, depth: int
@@ -250,20 +257,18 @@ def check(files: List[SourceFile]) -> List[Finding]:
         use = _scan_var_uses(fn_node, var, mod_seqs)
         for k, line in use.keys.items():
             consumed.setdefault(k, (sf.path, line))
-        if use.escapes or depth >= 3:
+        if use.escapes or depth >= graph.max_depth:
             if use.escapes:
                 wildcard_consumer = True
             return
         for callee, pos in use.forwards:
-            target = fn_tables[sf.path].get(callee)
+            target = graph.any_def_in_module(sf.path, callee)
             if target is None:
                 wildcard_consumer = True
                 continue
-            params = [a.arg for a in target.args.args]
-            if params and params[0] == "self":
-                pos += 1
-            if pos < len(params):
-                consume_via(sf, target, params[pos], mod_seqs, depth + 1)
+            param = target.param_for_arg(pos)
+            if param is not None:
+                consume_via(sf, target.node, param, mod_seqs, depth + 1)
 
     for sf in files:
         mod_seqs = _module_str_seqs(sf.tree)

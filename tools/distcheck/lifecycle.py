@@ -1,0 +1,349 @@
+"""Resource-lifecycle analysis (DC120, DC121).
+
+Tracks this project's acquire/release pairs through one function at a
+time, ``finally``/context-manager aware, with release-through-helper
+resolution via the shared call graph:
+
+* ``PageAllocator`` pages — ``x = <...>.alloc(n)`` ... ``free(x)``;
+* relay/directory connections — ``c = RelayClient(...)`` /
+  ``DirectoryClient(...)`` ... ``c.close()``;
+* raw sockets — ``socket.create_connection`` / ``socket.socket``.
+
+**DC120** — an exception path escapes the window between the acquire and
+its release/ownership-transfer without the release: under fault
+injection that's a leaked page (HBM capacity AND disagg-wire unit) or a
+leaked socket per retry.  The window ends when the resource is
+*published* (stored into long-lived state, returned, or handed to
+another owner) — after that the new owner's lifecycle applies.  A
+``with ... as x:`` acquire is always clean.  Acquires stored directly on
+``self`` are instance-owned (teardown's concern, not this function's).
+
+**DC121** — the same resource released twice along one straight-line
+block: a double-free (``PageAllocator.free`` raises on it; a socket
+double-close masks real errors).
+
+A deliberate escape takes ``# distcheck: leak-ok(reason)`` on the
+acquire line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Finding,
+    SourceFile,
+    call_name,
+    dotted,
+    graph_for,
+    register,
+)
+
+_CTORS = {"RelayClient", "DirectoryClient"}
+_SOCKET_CTORS = {"socket.create_connection", "socket.socket"}
+_RELEASE_ATTRS = {"close", "free", "release"}
+# Calls that never take ownership of (or raise because of) an argument.
+_TRANSPARENT = {
+    "len", "bool", "repr", "str", "print", "enumerate", "list", "sorted",
+    "zip", "range", "min", "max", "sum", "tuple", "set", "dict", "reversed",
+    "isinstance", "id", "iter", "next", "float", "int", "abs", "format",
+}
+# Container/bookkeeping method calls that cannot realistically raise —
+# they don't open an exception path out of the acquire window.
+_NONRAISING_ATTRS = {
+    "append", "extend", "insert", "add", "discard", "update", "setdefault",
+    "items", "keys", "values", "copy", "clear", "is_set",
+}
+
+
+def _is_acquire(call: ast.Call) -> Optional[str]:
+    """'pages' | 'conn' | None — what kind of resource this call acquires."""
+    name = call_name(call)
+    short = name.rsplit(".", 1)[-1]
+    if short == "alloc":
+        return "pages"
+    if short in _CTORS:
+        return "conn"
+    if name in _SOCKET_CTORS:
+        return "conn"
+    return None
+
+
+def _walk_no_nested(fn_node) -> List[ast.AST]:
+    """All nodes of a function body, excluding nested def/class subtrees."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _refs_value(node: ast.AST, name: str) -> bool:
+    """``name`` appears as a *value* — not merely as the receiver of a
+    method call (``client.get(...)`` uses client, it doesn't hand it off)."""
+    excluded: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            excluded.update(id(f) for f in ast.walk(n.func))
+    return any(
+        isinstance(n, ast.Name) and n.id == name and id(n) not in excluded
+        for n in ast.walk(node)
+    )
+
+
+class _FnScan:
+    def __init__(self, sf: SourceFile, fn_node, qual: str, graph):
+        self.sf = sf
+        self.fn = fn_node
+        self.qual = qual
+        self.graph = graph
+        self.cls = qual.rsplit(".", 2)[0] if "." in qual else None
+        self.nodes = _walk_no_nested(fn_node)
+        self.parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(fn_node):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+
+    # -- classification -------------------------------------------------------
+
+    def _is_release_of(self, call: ast.Call, target: str) -> bool:
+        """client.close() / allocator.free(s.pages) / helper(client) where
+        the resolved helper releases its bound parameter."""
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _RELEASE_ATTRS:
+                if dotted(call.func.value) == target:
+                    return True
+                if any(dotted(a) == target for a in call.args):
+                    return True
+        for pos, arg in enumerate(call.args):
+            if dotted(arg) != target:
+                continue
+            callee = self.graph.resolve_call(
+                self.sf, call, self.cls if self.cls else None
+            )
+            if callee is None:
+                continue
+            param = callee.param_for_arg(pos)
+            if param is None:
+                continue
+            for sub in ast.walk(callee.node):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and sub.func.attr in _RELEASE_ATTRS:
+                    if dotted(sub.func.value) == param or any(
+                        dotted(a) == param for a in sub.args
+                    ):
+                        return True
+        return False
+
+    def _is_publication(self, node: ast.AST, target: str, base: str) -> bool:
+        if isinstance(node, ast.Return):
+            return node.value is not None and (
+                _refs_value(node.value, base)
+            )
+        if isinstance(node, ast.Assign):
+            lhs_rooted = all(
+                dotted(t).split(".")[0] == base or dotted(t) == ""
+                and isinstance(t, ast.Subscript)
+                and dotted(t.value).split(".")[0] == base
+                for t in node.targets
+            )
+            if not lhs_rooted and _refs_value(node.value, base):
+                return True
+            return False
+        if isinstance(node, ast.Call):
+            short = call_name(node).rsplit(".", 1)[-1]
+            if short in _TRANSPARENT:
+                return False
+            return any(_refs_value(a, base) for a in node.args) or any(
+                _refs_value(kw.value, base) for kw in node.keywords
+            )
+        return False
+
+    def _base_is_fresh(self, target: str, acq_line: int) -> bool:
+        """True when the resource is anchored to a freshly built local —
+        a leak candidate.  Dotted targets qualify only when their base was
+        constructed in this function (``s = Session(...)``); loop vars,
+        parameters, and self-derived objects are owned elsewhere."""
+        base = target.split(".")[0]
+        if base == "self":
+            return False
+        if base == target:
+            return True
+        for node in self.nodes:
+            if isinstance(node, ast.Assign) and node.lineno < acq_line:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == base:
+                        v = node.value
+                        if isinstance(v, ast.Call):
+                            short = call_name(v).rsplit(".", 1)[-1]
+                            if short[:1].isupper():
+                                return True
+                        return False
+        return False
+
+    def _handler_nodes_of_acquire(self, acquire: ast.AST) -> Set[int]:
+        """Nodes inside ``except`` handlers of any ``try`` whose body holds
+        the acquire: if the acquire raised, the resource was never bound —
+        those handlers cannot leak it and are not part of the window."""
+        out: Set[int] = set()
+        node = acquire
+        while id(node) in self.parents:
+            child, node = node, self.parents[id(node)]
+            if isinstance(node, ast.Try) and child in node.body:
+                for h in node.handlers:
+                    for stmt in h.body:
+                        out.update(id(n) for n in ast.walk(stmt))
+        return out
+
+    def _protected(self, risky: ast.AST, target: str) -> bool:
+        node = risky
+        while id(node) in self.parents:
+            node = self.parents[id(node)]
+            if isinstance(node, ast.Try):
+                for blk in [node.finalbody] + [h.body for h in node.handlers]:
+                    for stmt in blk:
+                        for sub in ast.walk(stmt):
+                            if isinstance(sub, ast.Call) and (
+                                self._is_release_of(sub, target)
+                            ):
+                                return True
+        return False
+
+    # -- checks ---------------------------------------------------------------
+
+    def check(self) -> List[Finding]:
+        out: List[Finding] = []
+        acquires: List[Tuple[ast.Assign, str, str]] = []
+        for node in self.nodes:
+            if not isinstance(node, ast.Assign) or not node.targets:
+                continue
+            kinds = [
+                _is_acquire(c)
+                for c in ast.walk(node.value)
+                if isinstance(c, ast.Call)
+            ]
+            kind = next((k for k in kinds if k), None)
+            if kind is None:
+                continue
+            target = dotted(node.targets[0])
+            if not target or target.startswith("self."):
+                continue
+            if not self._base_is_fresh(target, node.lineno):
+                continue
+            acquires.append((node, target, kind))
+
+        fn_end = max(
+            (getattr(n, "end_lineno", None) or n.lineno
+             for n in self.nodes if hasattr(n, "lineno")),
+            default=0,
+        )
+        for node, target, kind in acquires:
+            if self.sf.ann.at(node.lineno, "leak-ok") is not None:
+                continue
+            base = target.split(".")[0]
+            # Window: from the acquire to the first release or publication.
+            end = fn_end + 1
+            for other in self.nodes:
+                line = getattr(other, "lineno", None)
+                if line is None or line <= node.lineno:
+                    continue
+                if isinstance(other, ast.Call) and self._is_release_of(
+                    other, target
+                ):
+                    end = min(end, line)
+                elif self._is_publication(other, target, base):
+                    end = min(end, line)
+            handler_ids = self._handler_nodes_of_acquire(node)
+            risky = [
+                c for c in self.nodes
+                if isinstance(c, ast.Call)
+                and node.lineno < c.lineno < end
+                and id(c) not in handler_ids
+                and call_name(c).rsplit(".", 1)[-1] not in _TRANSPARENT
+                and not (
+                    isinstance(c.func, ast.Attribute)
+                    and c.func.attr in _NONRAISING_ATTRS
+                )
+                and not self._is_release_of(c, target)
+            ]
+            unprotected = [
+                c for c in risky if not self._protected(c, target)
+            ]
+            if unprotected:
+                first = min(unprotected, key=lambda c: c.lineno)
+                what = "allocated pages" if kind == "pages" else "connection"
+                out.append(Finding(
+                    "DC120", self.sf.path, node.lineno,
+                    f"{self.qual}.{target}",
+                    f"{what} '{target}' can leak: "
+                    f"{call_name(first) or 'a call'}() at line {first.lineno} "
+                    "may raise before the release/ownership transfer and no "
+                    "finally/except releases it — free it on the error path "
+                    "or annotate leak-ok(reason)",
+                ))
+
+        # DC121: two releases of one target in the same straight-line block.
+        for body in self._bodies():
+            seen: Dict[str, int] = {}
+            for stmt in body:
+                if not isinstance(stmt, ast.Expr) or not isinstance(
+                    stmt.value, ast.Call
+                ):
+                    continue
+                call = stmt.value
+                tgt = None
+                if isinstance(call.func, ast.Attribute) and (
+                    call.func.attr in _RELEASE_ATTRS
+                ):
+                    recv = dotted(call.func.value)
+                    args = [dotted(a) for a in call.args if dotted(a)]
+                    tgt = args[0] if args else recv
+                if tgt:
+                    if tgt in seen:
+                        out.append(Finding(
+                            "DC121", self.sf.path, stmt.lineno,
+                            f"{self.qual}.{tgt}",
+                            f"'{tgt}' is released twice on the same path "
+                            f"(first at line {seen[tgt]}) — double-free/"
+                            "double-close",
+                        ))
+                    else:
+                        seen[tgt] = stmt.lineno
+        return out
+
+    def _bodies(self) -> List[List[ast.stmt]]:
+        out: List[List[ast.stmt]] = [self.fn.body]
+        for node in self.nodes:
+            for field in ("body", "orelse", "finalbody"):
+                blk = getattr(node, field, None)
+                if isinstance(blk, list) and blk and isinstance(
+                    blk[0], ast.stmt
+                ):
+                    out.append(blk)
+        return out
+
+
+@register
+def check(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    graph = graph_for(files)
+    for sf in files:
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_FnScan(sf, node, node.name, graph).check())
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        out.extend(_FnScan(
+                            sf, sub, f"{node.name}.{sub.name}", graph
+                        ).check())
+    return out

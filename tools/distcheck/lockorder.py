@@ -1,0 +1,373 @@
+"""Whole-program lock-order / lock-hold analysis (DC110, DC111).
+
+Built on the shared call graph (:class:`core.CallGraph`): per class,
+instance locks are inferred exactly as in ``locks.py`` (``self._x =
+threading.Lock()`` and friends); every method is then scanned with a
+held-lock stack, and the analysis follows resolved calls out of the
+lock region up to the graph's depth limit.
+
+* **DC110** — a cycle in the global lock-acquisition graph (lock ``A``
+  held while acquiring ``B`` somewhere, ``B`` held while acquiring ``A``
+  somewhere else — including through calls, and including re-acquiring a
+  non-reentrant lock): a potential deadlock the interleaving merely
+  hasn't hit yet.  Also fired when an acquisition contradicts a declared
+  ``# distcheck: lock-order(_a<_b)`` order.
+* **DC111** — a blocking call (socket send/recv/connect, relay or
+  directory RPC, ``.join()``, ``time.sleep``, device sync, ``.result()``)
+  made while holding a lock, directly or through a resolved callee: under
+  chaos faults one slow peer turns into a fleet-wide stall behind that
+  lock.
+
+``lock-order(_a<_b)`` documents the sanctioned order (and arms the
+contradiction check); a deliberate blocking call under a lock takes
+``# distcheck: blocking-ok(reason)`` on the call line.  Scope: DC110
+edges are collected package-wide; DC111 skips the engine/model/kernel
+directories (the engine's single-lock tick holds its lock across device
+work by design — the same documented scope cut as ``locks.py``).
+Module-level locks (one-shot build guards here) are out of scope; the
+analysis covers instance locks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Finding,
+    FunctionInfo,
+    SourceFile,
+    call_name,
+    dotted,
+    graph_for,
+    register,
+    self_attr,
+)
+from .locks import _LOCK_CTORS, _SKIP_SEGMENTS
+
+# Blocking-call classification for DC111 (narrower than asynclint's
+# event-loop set: metrics snapshots are lock-nesting, not blocking).
+_BLOCKING_ATTRS = {
+    "join": "joins a thread",
+    "block_until_ready": "synchronizes with the device",
+    "result": "blocks on a Future",
+    "sendall": "socket send",
+    "recv": "socket receive",
+    "recv_into": "socket receive",
+    "connect": "socket connect",
+    "accept": "socket accept",
+}
+_RPC_ATTRS = {
+    "put", "get", "put_many", "rpc", "ping", "cancel_queue",
+    "route", "register", "heartbeat", "lookup", "remove", "renew",
+}
+_RPC_RECEIVERS = ("relay", "client", "conn", "_out", "_directory")
+
+
+def _blocking_reason(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name == "time.sleep":
+        return "time.sleep"
+    if name.startswith("socket."):
+        return name
+    if name in ("jax.device_get", "jax.block_until_ready"):
+        return f"{name} (device sync)"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        base = dotted(node.func.value).rsplit(".", 1)[-1].lower()
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}() ({_BLOCKING_ATTRS[attr]})"
+        if attr in _RPC_ATTRS and any(k in base for k in _RPC_RECEIVERS):
+            return f".{attr}() RPC on {dotted(node.func.value)}"
+        if attr in ("send", "makefile") and "sock" in base:
+            return f"socket .{attr}()"
+    return None
+
+
+def _skip(path: str) -> bool:
+    parts = path.split("/")
+    return any(seg in _SKIP_SEGMENTS for seg in parts[:-1])
+
+
+def _class_locks(files: Sequence[SourceFile]) -> Dict[Tuple[str, str], Set[str]]:
+    """(path, ClassName) -> set of instance lock attribute names."""
+    out: Dict[Tuple[str, str], Set[str]] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, ast.Call
+                ):
+                    ctor = call_name(sub.value).rsplit(".", 1)[-1]
+                    if ctor in _LOCK_CTORS:
+                        for tgt in sub.targets:
+                            attr = self_attr(tgt)
+                            if attr is not None:
+                                attrs.add(attr)
+            if attrs:
+                out[(sf.path, node.name)] = attrs
+    return out
+
+
+class _Summary:
+    """What one function does lock-wise, not counting its callees."""
+
+    def __init__(self):
+        self.acquires: Set[str] = set()  # qualified "Cls._lock" ids
+        self.blocking: List[Tuple[int, str]] = []  # (line, reason)
+
+
+class _HeldScan(ast.NodeVisitor):
+    """Walk one method with a held-lock stack, recording direct nesting
+    edges and every call made while at least one lock is held."""
+
+    def __init__(self, checker: "_Checker", sf: SourceFile,
+                 fi: FunctionInfo, lock_attrs: Set[str], base: Sequence[str]):
+        self.checker = checker
+        self.sf = sf
+        self.fi = fi
+        self.lock_attrs = lock_attrs
+        self.held: List[str] = list(base)
+
+    def _qual(self, attr: str) -> str:
+        return f"{self.fi.cls}.{attr}"
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            ctx = item.context_expr
+            attr = self_attr(ctx)
+            if attr is None and isinstance(ctx, ast.Call):
+                attr = self_attr(ctx.func)
+            if attr is not None and attr in self.lock_attrs:
+                acquired.append(self._qual(attr))
+        for acq in acquired:
+            for held in self.held:
+                self.checker.add_edge(
+                    held, acq, self.sf, node.lineno, self.fi, "nests"
+                )
+            self.held.append(acq)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            self.checker.calls_under_lock.append(
+                (tuple(self.held), node, self.sf, self.fi)
+            )
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # nested defs run on other threads
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+
+class _Checker:
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        self.graph = graph_for(files)
+        self.cls_locks = _class_locks(files)
+        # edge (src,dst) -> first witness (sf, line, fi, kind)
+        self.edges: Dict[
+            Tuple[str, str], Tuple[SourceFile, int, FunctionInfo, str]
+        ] = {}
+        self.calls_under_lock: List[
+            Tuple[Tuple[str, ...], ast.Call, SourceFile, FunctionInfo]
+        ] = []
+        self.declared: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self._summaries: Dict[int, _Summary] = {}
+        self.out: List[Finding] = []
+
+    # -- graph construction ---------------------------------------------------
+
+    def add_edge(self, src: str, dst: str, sf: SourceFile, line: int,
+                 fi: FunctionInfo, kind: str) -> None:
+        self.edges.setdefault((src, dst), (sf, line, fi, kind))
+
+    def collect_declarations(self) -> None:
+        for sf in self.files:
+            for i, text in enumerate(sf.lines, start=1):
+                if "lock-order" not in text:
+                    continue
+                args = sf.ann.at(i, "lock-order")
+                if args and "<" in args:
+                    a, b = (s.strip() for s in args.split("<", 1))
+                    if a and b:
+                        self.declared.setdefault((a, b), (sf.path, i))
+
+    def scan_methods(self) -> None:
+        for sf in self.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                lock_attrs = self.cls_locks.get((sf.path, node.name), set())
+                if not lock_attrs:
+                    continue
+                for m in node.body:
+                    if not isinstance(
+                        m, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    base: List[str] = []
+                    held = sf.ann.at(m.lineno, "holds-lock")
+                    if held:
+                        base = [
+                            f"{node.name}.{a.strip()}"
+                            for a in held.split(",")
+                            if a.strip() in lock_attrs
+                        ]
+                    elif m.name.endswith("_locked"):
+                        base = [f"{node.name}.{a}" for a in sorted(lock_attrs)]
+                    fi = FunctionInfo(sf, m, m.name, node.name)
+                    scan = _HeldScan(self, sf, fi, lock_attrs, base)
+                    for stmt in m.body:
+                        scan.visit(stmt)
+
+    # -- interprocedural summaries -------------------------------------------
+
+    def _own_summary(self, fi: FunctionInfo) -> _Summary:
+        cached = self._summaries.get(id(fi.node))
+        if cached is not None:
+            return cached
+        s = _Summary()
+        lock_attrs = (
+            self.cls_locks.get((fi.sf.path, fi.cls), set()) if fi.cls else set()
+        )
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    attr = self_attr(ctx)
+                    if attr is None and isinstance(ctx, ast.Call):
+                        attr = self_attr(ctx.func)
+                    if attr is not None and attr in lock_attrs:
+                        s.acquires.add(f"{fi.cls}.{attr}")
+            elif isinstance(node, ast.Call):
+                reason = _blocking_reason(node)
+                if reason is not None and (
+                    fi.sf.ann.at(node.lineno, "blocking-ok") is None
+                ):
+                    s.blocking.append((node.lineno, reason))
+        self._summaries[id(fi.node)] = s
+        return s
+
+    def _transitive_summary(self, fi: FunctionInfo) -> _Summary:
+        total = _Summary()
+        own = self._own_summary(fi)
+        total.acquires |= own.acquires
+        total.blocking += [
+            (ln, f"{reason} in {fi.qualname}() at {fi.sf.path}:{ln}")
+            for ln, reason in own.blocking
+        ]
+        for _cur, _call, callee, _depth in self.graph.iter_calls(fi):
+            if callee is None:
+                continue
+            cs = self._own_summary(callee)
+            total.acquires |= cs.acquires
+            total.blocking += [
+                (ln, f"{reason} in {callee.qualname}() at "
+                     f"{callee.sf.path}:{ln}")
+                for ln, reason in cs.blocking
+            ]
+        return total
+
+    def resolve_calls_under_lock(self) -> None:
+        for held, call, sf, fi in self.calls_under_lock:
+            direct = _blocking_reason(call)
+            skip_dc111 = _skip(sf.path) or (
+                sf.ann.at(call.lineno, "blocking-ok") is not None
+            )
+            if direct is not None:
+                if not skip_dc111:
+                    self.out.append(Finding(
+                        "DC111", sf.path, call.lineno,
+                        f"{fi.qualname}:{call_name(call) or 'call'}",
+                        f"blocking call ({direct}) while holding "
+                        f"{', '.join(held)} — under a fault this stalls "
+                        "every thread behind the lock; move it outside the "
+                        "critical section or annotate blocking-ok(reason)",
+                    ))
+                continue
+            callee = self.graph.resolve_call(sf, call, fi.cls)
+            if callee is None or callee.node is fi.node:
+                continue
+            trans = self._transitive_summary(callee)
+            for acq in sorted(trans.acquires):
+                for h in held:
+                    self.add_edge(h, acq, sf, call.lineno, fi, "calls into")
+            if trans.blocking and not skip_dc111:
+                _ln, detail = trans.blocking[0]
+                self.out.append(Finding(
+                    "DC111", sf.path, call.lineno,
+                    f"{fi.qualname}:{callee.qualname}",
+                    f"call to {callee.qualname}() while holding "
+                    f"{', '.join(held)} reaches a blocking call: {detail}; "
+                    "move it outside the critical section or annotate "
+                    "blocking-ok(reason)",
+                ))
+
+    # -- DC110: cycles + declared-order contradictions ------------------------
+
+    def report_cycles(self) -> None:
+        adj: Dict[str, List[str]] = {}
+        for (src, dst) in self.edges:
+            adj.setdefault(src, []).append(dst)
+        for targets in adj.values():
+            targets.sort()
+        reported: Set[frozenset] = set()
+
+        def walk(node: str, path: List[str], path_index: Dict[str, int],
+                 seen: Set[str]) -> None:
+            for nxt in adj.get(node, []):
+                if nxt in path_index:
+                    cycle = path[path_index[nxt]:] + [nxt]
+                    key = frozenset(cycle)
+                    if key not in reported:
+                        reported.add(key)
+                        sf, line, _fi, kind = self.edges[(node, nxt)]
+                        self.out.append(Finding(
+                            "DC110", sf.path, line,
+                            "lockorder." + "<".join(sorted(key)),
+                            "lock-acquisition cycle "
+                            f"{' -> '.join(cycle)} (this site {kind} "
+                            f"{nxt} while holding {node}) — a potential "
+                            "deadlock; pick one global order and "
+                            "declare it with lock-order(a<b)",
+                        ))
+                elif nxt not in seen:
+                    seen.add(nxt)
+                    path_index[nxt] = len(path)
+                    walk(nxt, path + [nxt], path_index, seen)
+                    del path_index[nxt]
+
+        for start in sorted(adj):
+            walk(start, [start], {start: 0}, {start})
+
+        for (src, dst), (sf, line, fi, kind) in sorted(self.edges.items()):
+            a, b = src.rsplit(".", 1)[-1], dst.rsplit(".", 1)[-1]
+            decl = self.declared.get((b, a))
+            if decl is not None and a != b:
+                self.out.append(Finding(
+                    "DC110", sf.path, line,
+                    f"lockorder.{src}>{dst}",
+                    f"acquiring {dst} while holding {src} contradicts the "
+                    f"declared lock-order({b}<{a}) at {decl[0]}:{decl[1]}",
+                ))
+
+
+@register
+def check(files: List[SourceFile]) -> List[Finding]:
+    c = _Checker(files)
+    c.collect_declarations()
+    c.scan_methods()
+    c.resolve_calls_under_lock()
+    c.report_cycles()
+    return c.out

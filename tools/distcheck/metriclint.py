@@ -31,7 +31,7 @@ import fnmatch
 import re
 from typing import Dict, List, Optional, Tuple
 
-from .core import Finding, SourceFile, register
+from .core import Finding, SourceFile, is_subset_scan, register
 
 _EMITTERS = {
     "counter": "counter",
@@ -270,7 +270,10 @@ def check(files: List[SourceFile]) -> List[Finding]:
                         "# distcheck: metric(name, ...)",
                     ))
 
-    if registry_file is not None and any_call_site:
+    # Dead-declaration evidence is "no scanned call site emits it" — on a
+    # subset scan (--changed) the emitters are usually the files NOT in
+    # the scan, so the closed-world check stays silent.
+    if registry_file is not None and any_call_site and not is_subset_scan():
         for name, (kind, line) in sorted(registry.items()):
             if name not in used:
                 out.append(Finding(

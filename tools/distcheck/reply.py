@@ -1,0 +1,230 @@
+"""Reply-guarantee analysis for frame consumers (DC130).
+
+The PR-3 bug class this pins down: a relay/disagg frame consumer takes a
+request off its queue and then bails — silent ``continue`` or bare
+``return`` — without sending a reply frame, raising into a caller that
+does, or hitting a declared error counter.  The requester learns nothing
+and hangs out its full timeout.
+
+Consumer entry points (the project's conventions, resolved through the
+shared call graph):
+
+* methods named ``_consume`` or ``_serve`` — the relay/hub serve loops;
+* functions registered as a ``TaskPool`` batch handler
+  (``TaskPool(self._process_batch, ...)``);
+* direct callees of either that receive the decoded request/header
+  (``self._handle(header, reply)``) — one hop through the call graph.
+
+Within a consumer, every ``continue`` / bare ``return`` lexically after
+the first frame decode (``unpack_frame`` / ``_unpack`` / ``json.loads``)
+must be *guarded*: a reply primitive (``.put`` / ``.put_many`` /
+``pack_frame`` / ``encode_error`` / ``encode_kv``), a delegation
+(``.submit`` to a task pool), a declared error counter
+(``metrics.counter``), or a ``raise`` must appear on the path before it
+(preceding statements in its own block and ancestor blocks — conditional
+``if`` siblings don't count, their branch may not have run; a ``try``
+body doesn't vouch for its own ``except`` handler).  A ``return`` with a
+value hands the reply to the caller and is exempt; exits before the
+first decode never consumed a request.  A deliberate silent exit takes
+``# distcheck: reply-ok(reason)`` on the exit line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    Finding,
+    FunctionInfo,
+    SourceFile,
+    call_name,
+    graph_for,
+    register,
+    self_attr,
+)
+
+_UNPACKERS = {"unpack_frame", "_unpack", "loads"}
+_REPLY_ATTRS = {"put", "put_many"}
+_REPLY_FNS = {"pack_frame", "encode_error", "encode_kv", "_pack"}
+_ENTRY_NAMES = {"_consume", "_serve"}
+
+
+def _is_guard(node: ast.AST) -> bool:
+    if isinstance(node, ast.Raise):
+        return True
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            _REPLY_ATTRS | {"submit", "counter"}
+        ):
+            return True
+        if call_name(node).rsplit(".", 1)[-1] in _REPLY_FNS:
+            return True
+    return False
+
+
+def _contains_guard(stmt: ast.stmt) -> bool:
+    return any(_is_guard(n) for n in ast.walk(stmt))
+
+
+class _Consumer:
+    def __init__(self, sf: SourceFile, fi: FunctionInfo, first_line: int):
+        self.sf = sf
+        self.fi = fi
+        self.first_line = first_line  # exits before this line are exempt
+
+
+def _first_unpack(fn_node) -> Tuple[Optional[str], Optional[int]]:
+    """(header var, line) of the first frame decode in the function."""
+    best: Tuple[Optional[str], Optional[int]] = (None, None)
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        )):
+            continue
+        short = call_name(node.value).rsplit(".", 1)[-1]
+        if short not in _UNPACKERS:
+            continue
+        tgt = node.targets[0]
+        var = None
+        if isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts and isinstance(
+            tgt.elts[0], ast.Name
+        ):
+            var = tgt.elts[0].id
+        elif isinstance(tgt, ast.Name):
+            var = tgt.id
+        if var and var != "_" and (
+            best[1] is None or node.lineno < best[1]
+        ):
+            best = (var, node.lineno)
+    return best
+
+
+def _find_consumers(files, graph) -> List[_Consumer]:
+    out: List[_Consumer] = []
+    seen: Set[int] = set()
+
+    def add(sf, fi, first_line):
+        if id(fi.node) in seen:
+            return
+        seen.add(id(fi.node))
+        out.append(_Consumer(sf, fi, first_line))
+
+    entries: List[Tuple[SourceFile, FunctionInfo]] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if not isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    fi = FunctionInfo(sf, sub, sub.name, node.name)
+                    if sub.name in _ENTRY_NAMES:
+                        entries.append((sf, fi))
+                    for call in ast.walk(sub):
+                        # TaskPool(self._handler, ...) registers a consumer.
+                        if isinstance(call, ast.Call) and call_name(
+                            call
+                        ).rsplit(".", 1)[-1] == "TaskPool" and call.args:
+                            attr = self_attr(call.args[0])
+                            if attr is not None:
+                                handler = graph.method(sf, node.name, attr)
+                                if handler is not None:
+                                    add(sf, handler, 0)
+
+    for sf, fi in entries:
+        var, line = _first_unpack(fi.node)
+        if line is None:
+            continue  # no frame decode: not a request consumer
+        add(sf, fi, line)
+        # One hop: a callee handed the decoded request is a consumer too.
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            if not any(
+                isinstance(a, ast.Name) and a.id == var for a in call.args
+            ):
+                continue
+            callee = graph.resolve_call(sf, call, fi.cls)
+            if callee is not None:
+                add(callee.sf, callee, 0)
+    return out
+
+
+def _guarded(exit_stmt: ast.stmt, fn_node, parents: Dict[int, ast.AST]) -> bool:
+    """True when a reply/delegation/counter/raise precedes the exit on its
+    own path: preceding siblings in each ancestor block, recursively —
+    but not inside preceding ``if`` statements (their branch may not have
+    executed), and a ``try`` body doesn't vouch for its handlers."""
+    child: ast.AST = exit_stmt
+    while True:
+        parent = parents.get(id(child))
+        if parent is None or isinstance(
+            parent, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            blocks = [parent.body] if parent is not None else []
+        elif isinstance(parent, ast.Try) and isinstance(
+            child, ast.ExceptHandler
+        ):
+            child = parent  # the try body may not have reached its reply
+            continue
+        else:
+            blocks = [
+                blk for blk in (
+                    getattr(parent, "body", None),
+                    getattr(parent, "orelse", None),
+                    getattr(parent, "finalbody", None),
+                )
+                if isinstance(blk, list)
+            ]
+        for blk in blocks:
+            if child in blk:
+                for stmt in blk[: blk.index(child)]:
+                    if isinstance(stmt, ast.If):
+                        continue  # conditional sibling: may not have run
+                    if _contains_guard(stmt):
+                        return True
+        if parent is None or isinstance(
+            parent, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            return False
+        child = parent
+
+
+@register
+def check(files: List[SourceFile]) -> List[Finding]:
+    graph = graph_for(files)
+    out: List[Finding] = []
+    for c in _find_consumers(files, graph):
+        fn_node = c.fi.node
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(fn_node):
+            for sub in ast.iter_child_nodes(node):
+                parents[id(sub)] = node
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Continue):
+                kind = "continue"
+            elif isinstance(node, ast.Return) and (
+                node.value is None
+                or (isinstance(node.value, ast.Constant)
+                    and node.value.value is None)
+            ):
+                kind = "return"
+            else:
+                continue
+            if node.lineno < c.first_line:
+                continue  # before the first decode: nothing consumed yet
+            if c.sf.ann.at(node.lineno, "reply-ok") is not None:
+                continue
+            if _guarded(node, fn_node, parents):
+                continue
+            out.append(Finding(
+                "DC130", c.sf.path, node.lineno,
+                f"{c.fi.qualname}:{kind}",
+                f"consumer {c.fi.qualname}() drops a request with a silent "
+                f"{kind}: no reply frame, no raise, no declared error "
+                "counter on this path — the requester hangs out its "
+                "timeout; reply/count it or annotate reply-ok(reason)",
+            ))
+    return out
