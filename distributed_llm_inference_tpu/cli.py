@@ -751,7 +751,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "'drop:block.*:put:after=5,count=2', "
                         "'corrupt:client.*:reply', 'delay:*:any:"
                         "delay_s=0.2,prob=0.3,count=none'; kinds: drop, "
-                        "delay, duplicate, truncate, corrupt, sever")
+                        "delay, duplicate, truncate, corrupt, sever, crash "
+                        "(crash = whole-node death after N matched frames: "
+                        "severs every connection through the proxy and "
+                        "refuses reconnects, so heartbeats stop too and "
+                        "the node's directory lease expires)")
     c.set_defaults(fn=cmd_chaos)
 
     i = sub.add_parser("info", help="inspect a checkpoint")

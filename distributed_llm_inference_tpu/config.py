@@ -346,3 +346,27 @@ class DisaggConfig:
     fallback_local: bool = True
     # Prefill worker lease heartbeat period (seconds).
     heartbeat_s: float = 2.0
+    # Directory lease TTL for fleet workers (seconds). A node whose
+    # heartbeat lapses for this long drops out of ``alive()`` and is
+    # treated as dead by the recovery gateway. Keep comfortably above
+    # ``heartbeat_s`` (>= 2x) so one dropped heartbeat is not a death.
+    lease_ttl_s: float = 6.0
+    # Decode nodes export a session checkpoint (KV planes + RNG + token
+    # tail via ``encode_session``) after the first token and then every
+    # N engine ticks. Smaller = less replay work after a crash, more
+    # transfer bytes during healthy decode. 0 disables periodic
+    # checkpoints (first-token checkpoint still ships).
+    checkpoint_interval_ticks: int = 8
+    # How many times the gateway will migrate one stream to a new node
+    # after decode-node deaths before failing the request.
+    resume_max_attempts: int = 2
+    # Deadline-aware shedding during recovery storms: a resume is shed
+    # (terminal ``shed`` event, no migration) when the request's
+    # remaining deadline budget is under ``shed_headroom_s`` multiplied
+    # by the number of concurrently recovering requests.
+    shed_headroom_s: float = 0.5
+    # A stream with no frames for this long triggers a directory
+    # liveness probe; the node must also be absent from ``alive()``
+    # (lease expired) before it is declared dead. 0 derives the window
+    # from ``lease_ttl_s``.
+    dead_after_s: float = 0.0

@@ -11,11 +11,23 @@ Pieces:
 * :mod:`.prefill_worker` — the prefill-only role: registers with the
   block directory under ``role="prefill"``, consumes prompt requests,
   and answers with KV frames (or a single error frame).
+* :mod:`.decode_node` — the recoverable decode role: streams
+  sequence-stamped tokens back to a gateway and ships periodic session
+  checkpoints (``encode_session`` frames) so the stream can be re-homed
+  onto another node after a crash with zero token loss.
 
-The gateway side lives in ``serving.backends.DisaggBackend``.
+The gateway sides live in ``serving.backends.DisaggBackend`` (prefill
+shipping) and ``serving.backends.FleetBackend`` (crash recovery).
 """
 
-from .kv_codec import decode_kv, encode_error, encode_kv
+from .decode_node import DecodeNode
+from .kv_codec import (
+    decode_kv, decode_session, encode_error, encode_kv, encode_session,
+)
 from .prefill_worker import PrefillWorker
 
-__all__ = ["encode_kv", "decode_kv", "encode_error", "PrefillWorker"]
+__all__ = [
+    "encode_kv", "decode_kv", "encode_error",
+    "encode_session", "decode_session",
+    "PrefillWorker", "DecodeNode",
+]

@@ -1,0 +1,419 @@
+"""Decode-pool node: the serving half of crash-recoverable decode.
+
+A :class:`DecodeNode` wraps a full-model :class:`InferenceEngine` that
+runs DECODE for remote gateways: it registers with the block directory
+under ``role="decode"`` with a lease-fencing epoch, consumes session ops
+off its relay queue, streams every generated token back as a
+sequence-stamped ``migrate.tok`` frame, and periodically ships a full
+session checkpoint (``kv_codec.encode_session`` frames, KV planes + RNG
+key + token tail) so a gateway can re-home the stream onto another node
+after this one dies — with zero token loss.
+
+Request frames (``messages.pack_frame`` JSON headers)::
+
+    {"op": "migrate.submit", "gen": <gateway id>, "reply": <queue>,
+     "att": <attempt tag>, "prompt": [int, ...],
+     "options": {SamplingOptions fields}, "deadline_s": float|None}
+
+    {"op": "migrate.resume", "gen", "reply", "att",
+     "kv": <queue holding a checkpoint>, "nf": <frame count>,
+     "from": <gateway's delivered-token count>, "deadline_s": ...}
+
+    {"op": "migrate.cancel", "gen"}       # stop one stream
+    {"op": "shutdown"}                    # stop the node (tests)
+
+Reply frames (to the request's ``reply`` queue, all stamped with the
+request's ``att`` so a fenced attempt's frames are discardable)::
+
+    {"op": "migrate.tok", "gen", "att", "seq", "tok", "fin", "reason"}
+    {"op": "migrate.err", "gen", "att", "error"}     # admission failed
+    kv_codec session frames with header op = "migrate.ckpt"
+
+``seq`` is the token's index in the stream's GENERATED sequence — the
+exactly-once dedup key. On ``migrate.resume`` the node first REPLAYS the
+checkpoint's token tail from the gateway's ``from`` index (tokens the
+source emitted after the gateway's last delivery but before its death
+would otherwise be lost), then continues decoding; with the snapshot's
+RNG restored on a quiet engine the continued stream is byte-exact vs an
+uninterrupted run (see ``engine.resume_session``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..config import DisaggConfig
+from ..distributed.directory import DirectoryClient
+from ..distributed.messages import pack_frame, unpack_frame
+from ..distributed.relay import RelayClient
+from ..engine.sampling import SamplingOptions
+from .kv_codec import decode_session, encode_session
+
+__all__ = ["DecodeNode"]
+
+logger = logging.getLogger("distributed_llm_inference_tpu")
+
+_OPT_FIELDS = {f.name for f in dataclasses.fields(SamplingOptions)}
+
+
+@dataclasses.dataclass
+class _Route:
+    """Per-stream bookkeeping: where tokens go and how they are stamped."""
+
+    gen: str  # gateway-side request id
+    reply: str  # relay queue the gateway consumes
+    att: str  # attempt tag (fencing: stale attempts' frames are dropped)
+    seq: int  # next sequence index to assign
+    seq0: int  # first fresh index (tokens before it came from a snapshot)
+    # Checkpoint tail replay for a resumed stream: (seq, token) pairs the
+    # gateway had not yet delivered. Flushed before any fresh token.
+    replay: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    ckpted: bool = False
+    last_ckpt_tick: int = 0
+
+
+class DecodeNode:
+    """Serve recoverable decode streams over the relay (background
+    threads): consume loop for ops, driver loop stepping the engine and
+    fanning tokens/checkpoints out, heartbeat loop renewing the
+    epoch-fenced directory lease."""
+
+    def __init__(
+        self,
+        relay_port: int,
+        engine,
+        host: str = "127.0.0.1",
+        node_id: Optional[str] = None,
+        disagg_cfg: Optional[DisaggConfig] = None,
+        lease_ttl: Optional[float] = None,
+        epoch: int = 1,
+    ):
+        self.engine = engine
+        self.node_id = node_id or f"decode-{uuid.uuid4().hex[:8]}"
+        self.queue = f"decode.{self.node_id}"
+        self.host, self.relay_port = host, relay_port
+        self.dcfg = disagg_cfg or DisaggConfig()
+        self.lease_ttl = (
+            lease_ttl if lease_ttl is not None else self.dcfg.lease_ttl_s
+        )
+        self.epoch = int(epoch)  # incarnation number (lease fencing)
+        self.metrics = engine.metrics
+        self._stop = threading.Event()
+        self._ticks = 0
+        # engine gen_id -> _Route, plus the gateway-id reverse map for
+        # cancels. Consume thread inserts, driver thread reads/retires —
+        # every access under the lock; frames are SENT outside it.
+        self._rlock = threading.Lock()
+        self._routes: Dict[str, _Route] = {}
+        self._by_gen: Dict[str, str] = {}
+        # Register FIRST (mirrors PrefillWorker): a directory/relay
+        # failure here must not leak threads or sockets.
+        self._directory = DirectoryClient(relay_port, host)
+        try:
+            if not self._register():
+                raise RuntimeError(
+                    f"registration fenced: node {self.node_id} epoch "
+                    f"{self.epoch} is stale — restart with a higher epoch"
+                )
+            self._out = RelayClient(host, relay_port)
+        except Exception:
+            self._directory.close()
+            raise
+        self._consume_thread = threading.Thread(
+            target=self._consume, daemon=True, name=f"{self.node_id}.consume"
+        )
+        self._consume_thread.start()
+        self._drive_thread = threading.Thread(
+            target=self._drive, daemon=True, name=f"{self.node_id}.drive"
+        )
+        self._drive_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name=f"{self.node_id}.health",
+        )
+        self._health_thread.start()
+
+    def _register(self) -> bool:
+        return self._directory.register(
+            self.node_id, 0, self.engine.cfg.num_layers - 1, self.queue,
+            ttl=self.lease_ttl, role="decode", epoch=self.epoch,
+        )
+
+    # -- op consume loop ------------------------------------------------------
+
+    def _consume(self) -> None:
+        client = RelayClient(self.host, self.relay_port)
+        try:
+            while not self._stop.is_set():
+                try:
+                    frame = client.get(self.queue, timeout=0.5)
+                except TimeoutError:
+                    continue
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    header, _ = unpack_frame(frame)
+                    op = header.get("op")
+                except Exception:
+                    self.metrics.counter("malformed_frames")
+                    continue
+                if op == "shutdown":
+                    return  # distcheck: reply-ok(shutdown frames are fire-and-forget)
+                if op == "migrate.cancel":
+                    self._handle_cancel(header)
+                    continue  # distcheck: reply-ok(cancel acks ride the token stream)
+                if op not in ("migrate.submit", "migrate.resume"):
+                    self.metrics.counter("unknown_ops_dropped")
+                    continue
+                reply = header.get("reply")
+                if not reply:
+                    continue  # distcheck: reply-ok(frame carries no reply address)
+                if op == "migrate.submit":
+                    self._handle_submit(header, reply)
+                else:
+                    self._handle_resume(header, reply, client)
+        finally:
+            client.close()
+
+    @staticmethod
+    def _deadline_from(header: dict) -> Optional[float]:
+        d = header.get("deadline_s")
+        return None if d is None else time.monotonic() + float(d)
+
+    def _handle_submit(self, header: dict, reply: str) -> None:
+        gen = str(header.get("gen", ""))
+        att = str(header.get("att", ""))
+        try:
+            prompt = [int(t) for t in header["prompt"]]
+            opts = SamplingOptions(**{
+                k: v for k, v in (header.get("options") or {}).items()
+                if k in _OPT_FIELDS
+            })
+            gid = self.engine.submit(
+                prompt, opts, deadline=self._deadline_from(header)
+            )
+        except Exception as e:
+            logger.warning("submit %s failed on %s: %r", gen, self.node_id, e)
+            self._send_err(reply, gen, att, repr(e))
+            return  # distcheck: reply-ok(migrate.err reply sent via _send_err)
+        with self._rlock:
+            self._routes[gid] = _Route(gen=gen, reply=reply, att=att,
+                                       seq=0, seq0=0)
+            self._by_gen[gen] = gid
+
+    def _handle_resume(self, header: dict, reply: str,
+                       client: RelayClient) -> None:
+        gen = str(header.get("gen", ""))
+        att = str(header.get("att", ""))
+        try:
+            kvq = header["kv"]
+            nf = int(header["nf"])
+            frm = int(header.get("from") or 0)
+            budget = time.monotonic() + self.dcfg.transfer_timeout_s
+            frames = []
+            for _ in range(nf):
+                frames.append(client.get(
+                    kvq, timeout=max(budget - time.monotonic(), 0.001)
+                ))
+            snap, _meta = decode_session(frames)
+            if snap is None:
+                raise ValueError("checkpoint transfer carried an error frame")
+            tail = [int(t) for t in snap["generated"]]
+            gid = self.engine.resume_session(
+                snap, deadline=self._deadline_from(header)
+            )
+            if gid is None:
+                raise RuntimeError("no decode slot free (pool pressure)")
+        except Exception as e:
+            logger.warning("resume %s failed on %s: %r", gen, self.node_id, e)
+            self._send_err(reply, gen, att, repr(e))
+            return  # distcheck: reply-ok(migrate.err reply sent via _send_err)
+        g0 = len(tail)
+        replay = [(i, tail[i]) for i in range(max(0, min(frm, g0)), g0)]
+        with self._rlock:
+            self._routes[gid] = _Route(
+                gen=gen, reply=reply, att=att, seq=g0, seq0=g0,
+                replay=replay, last_ckpt_tick=self._ticks,
+            )
+            self._by_gen[gen] = gid
+
+    def _handle_cancel(self, header: dict) -> None:
+        gen = str(header.get("gen", ""))
+        with self._rlock:
+            gid = self._by_gen.get(gen)
+        if gid is not None:
+            self.engine.cancel(gid)
+
+    def _send_err(self, reply: str, gen: str, att: str, error: str) -> None:
+        try:
+            self._out.put(reply, pack_frame(
+                {"op": "migrate.err", "gen": gen, "att": att, "error": error}
+            ))
+        except (ConnectionError, OSError):
+            pass  # gateway's death detector takes it from here
+
+    # -- driver loop ----------------------------------------------------------
+
+    def _drive(self) -> None:
+        while not self._stop.is_set():
+            if not self.engine.has_work():
+                self._flush_replays()
+                time.sleep(0.002)
+                continue
+            events = self.engine.step()
+            # distcheck: unguarded-ok(driver-owned monotonic counter; the
+            # consume thread only reads it to seed checkpoint pacing, and a
+            # one-tick-stale read just shifts a checkpoint by one tick)
+            self._ticks += 1
+            self._flush_replays()
+            retired: List[str] = []
+            for gid, tok, fin in events:
+                with self._rlock:
+                    r = self._routes.get(gid)
+                if r is None:
+                    continue
+                self._flush_replay_route(r)
+                reason = None
+                if fin:
+                    s = self.engine.sessions.get(gid)
+                    reason = s.finish_reason if s is not None else None
+                frames: List[Tuple[str, bytes]] = []
+                if tok >= 0:
+                    frames.append((r.reply, pack_frame({
+                        "op": "migrate.tok", "gen": r.gen, "att": r.att,
+                        "seq": r.seq, "tok": int(tok), "fin": bool(fin),
+                        "reason": reason,
+                    })))
+                    r.seq += 1
+                else:  # finish without a new token
+                    frames.append((r.reply, pack_frame({
+                        "op": "migrate.tok", "gen": r.gen, "att": r.att,
+                        "seq": None, "tok": -1, "fin": True,
+                        "reason": reason,
+                    })))
+                if not self._send(frames):
+                    # Reply path is gone (gateway died or we are
+                    # partitioned): stop burning decode on this stream.
+                    self.engine.cancel(gid)
+                    retired.append(gid)
+                elif fin:
+                    retired.append(gid)
+            if retired:
+                with self._rlock:
+                    for gid in retired:
+                        r = self._routes.pop(gid, None)
+                        if r is not None:
+                            self._by_gen.pop(r.gen, None)
+            self._ship_checkpoints()
+            self.engine.collect_finished()
+
+    def _flush_replays(self) -> None:
+        with self._rlock:
+            routes = [r for r in self._routes.values() if r.replay]
+        for r in routes:
+            self._flush_replay_route(r)
+
+    def _flush_replay_route(self, r: _Route) -> None:
+        """Emit a resumed stream's checkpoint-tail tokens (never a finish:
+        export_session only snapshots ACTIVE sessions, so the tail cannot
+        contain eos and cannot exhaust max_new_tokens)."""
+        if not r.replay:
+            return
+        pending, r.replay = r.replay, []
+        self._send([
+            (r.reply, pack_frame({
+                "op": "migrate.tok", "gen": r.gen, "att": r.att,
+                "seq": seq, "tok": tok, "fin": False, "reason": None,
+            }))
+            for seq, tok in pending
+        ])
+
+    def _ship_checkpoints(self) -> None:
+        interval = self.dcfg.checkpoint_interval_ticks
+        with self._rlock:
+            routes = list(self._routes.items())
+        for gid, r in routes:
+            if r.seq <= r.seq0:
+                continue  # nothing streamed yet — the gateway can resubmit
+            due = not r.ckpted or (
+                interval > 0 and self._ticks - r.last_ckpt_tick >= interval
+            )
+            if not due:
+                continue
+            snap = self.engine.export_session(gid)
+            if snap is None:
+                continue  # finished under us; the fin frame already went out
+            frames = encode_session(
+                r.gen, snap,
+                page_size=self.engine.ccfg.page_size,
+                max_frame_bytes=self.dcfg.kv_frame_bytes,
+                att=r.att,
+            )
+            if self._send([(r.reply, f) for f in frames]):
+                r.ckpted = True
+                r.last_ckpt_tick = self._ticks
+                self.metrics.counter("checkpoints_shipped")
+                self.metrics.counter("checkpoint_frames_sent", len(frames))
+
+    def _send(self, frames: List[Tuple[str, bytes]]) -> bool:
+        if not frames:
+            return True
+        try:
+            self._out.put_many(frames)
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    # -- health ---------------------------------------------------------------
+
+    def _health_loop(self) -> None:
+        beat = min(self.dcfg.heartbeat_s, max(self.lease_ttl / 3.0, 0.05))
+        while not self._stop.wait(beat):
+            try:
+                alive = self._directory.heartbeat(
+                    self.node_id, load=self.engine.active_sessions(),
+                    ttl=self.lease_ttl, epoch=self.epoch,
+                )
+                if not alive:  # lease lapsed (e.g. partition healed)
+                    if not self._register():
+                        # Fenced: a gateway declared this incarnation dead
+                        # and re-homed its streams. Serving on would race
+                        # the successor — wind down instead.
+                        logger.warning(
+                            "node %s epoch %d fenced; stopping",
+                            self.node_id, self.epoch,
+                        )
+                        self._stop.set()
+                        return
+            except Exception:
+                continue  # transient control-plane failure: keep serving
+
+    def is_healthy(self) -> bool:
+        return (
+            self._consume_thread.is_alive()
+            and self._drive_thread.is_alive()
+            and not self._stop.is_set()
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consume_thread.join(timeout=5)
+        self._drive_thread.join(timeout=5)
+        self._health_thread.join(timeout=5)
+        try:
+            self._directory.remove(self.node_id)
+        except Exception:
+            pass
+        self._directory.close()
+        self._out.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -37,13 +37,21 @@ import numpy as np
 
 from ..distributed.relay import RelayClient
 
-__all__ = ["encode_kv", "decode_kv", "encode_error"]
+__all__ = [
+    "encode_kv", "decode_kv", "encode_error",
+    "encode_session", "decode_session",
+]
 
 VERSION = 1
 
 # Header keys that must agree across every frame of one transfer.
+# ``op``/``session``/``att`` arrived with session migration (checkpoint
+# frames); pre-migration frames simply lack them, which reads back as a
+# consistent ``None`` — the codec stays wire-compatible in both
+# directions. ``att`` is the gateway's attempt tag: recovery consumers
+# fence frames whose tag predates the current attempt (zombie replies).
 _CONSISTENT = ("gens", "n", "n_valid", "first_token", "quant", "chain",
-               "ps", "crc", "total", "dtypes")
+               "ps", "crc", "total", "dtypes", "op", "session", "att")
 
 
 def _pack(header: dict, chunk: bytes = b"") -> bytes:
@@ -81,8 +89,17 @@ def encode_kv(
     page_size: int = 0,
     quant: bool = False,
     max_frame_bytes: int = 4 * 1024 * 1024,
+    op: Optional[str] = None,
+    session: Optional[dict] = None,
+    att: Optional[str] = None,
 ) -> List[bytes]:
-    """Serialize one session's KV planes into an ordered list of frames."""
+    """Serialize one session's KV planes into an ordered list of frames.
+
+    ``op`` labels the transfer's purpose on the wire (``migrate.ckpt``
+    for session checkpoints; ``None`` for plain prefill exports) and
+    ``session`` carries the JSON-safe mid-decode state dict a checkpoint
+    needs beyond KV — both ride every frame's header, like the rest of
+    the consistent metadata."""
     payload = b"".join(_encode_plane(k, v) for k, v in planes.items())
     step = max(int(max_frame_bytes), 1)
     chunks = [payload[i : i + step] for i in range(0, len(payload), step)]
@@ -100,6 +117,9 @@ def encode_kv(
         "crc": zlib.crc32(payload) & 0xFFFFFFFF,
         "total": len(payload),
         "dtypes": {k: np.asarray(v).dtype.name for k, v in planes.items()},
+        "op": op,
+        "session": session,
+        "att": att,
     }
     return [_pack(dict(header, i=i), c) for i, c in enumerate(chunks)]
 
@@ -176,3 +196,66 @@ def decode_kv(
     meta = dict(base)
     meta["chain"] = [bytes.fromhex(c) for c in meta.get("chain") or []]
     return planes, meta
+
+
+# Keys of engine.export_session's snapshot that travel in the header's
+# ``session`` dict (everything but the binary KV planes).
+_SESSION_FIELDS = ("prompt", "generated", "options", "rng", "resumes")
+
+
+def encode_session(
+    gen_id: str,
+    snapshot: dict,
+    *,
+    page_size: int = 0,
+    max_frame_bytes: int = 4 * 1024 * 1024,
+    op: str = "migrate.ckpt",
+    att: Optional[str] = None,
+    extra_chain: Sequence[bytes] = (),
+) -> List[bytes]:
+    """Serialize an ``engine.export_session`` snapshot into kv_codec
+    frames: the KV planes ride the payload exactly like a prefill
+    export, the JSON-safe session state (token tail, options, RNG key)
+    rides every header. ``n_valid`` follows the KV-after-decode
+    invariant (``len(prompt) + len(generated) - 1``) and
+    ``first_token`` is the next decode input (``generated[-1]``)."""
+    planes = snapshot["planes"]
+    sess = {k: snapshot[k] for k in _SESSION_FIELDS}
+    generated = snapshot["generated"]
+    return encode_kv(
+        gen_id,
+        planes,
+        n_valid=len(snapshot["prompt"]) + len(generated) - 1,
+        first_token=int(generated[-1]),
+        chain=extra_chain,
+        page_size=page_size,
+        quant="ks" in planes,
+        max_frame_bytes=max_frame_bytes,
+        op=op,
+        session=sess,
+        att=att,
+    )
+
+
+def decode_session(
+    frames: Iterable[bytes],
+) -> Tuple[Optional[dict], dict]:
+    """Reassemble :func:`encode_session` frames back into a snapshot dict
+    ``engine.resume_session`` accepts (planes + session state merged).
+
+    Returns ``(snapshot, meta)``; an error frame returns ``(None, meta)``
+    with ``meta["error"]`` set. Raises ``ValueError`` on any integrity
+    violation :func:`decode_kv` detects, or when the frames carry no
+    session state (a plain prefill transfer fed to the wrong decoder)."""
+    planes, meta = decode_kv(frames)
+    if planes is None:
+        return None, meta
+    sess = meta.get("session")
+    if not isinstance(sess, dict):
+        raise ValueError("kv frames carry no session state")
+    missing = [k for k in _SESSION_FIELDS if k not in sess]
+    if missing:
+        raise ValueError(f"session snapshot missing fields {missing}")
+    snapshot = dict(sess)
+    snapshot["planes"] = planes
+    return snapshot, meta

@@ -58,6 +58,7 @@ class PrefillWorker:
         node_id: Optional[str] = None,
         disagg_cfg: Optional[DisaggConfig] = None,
         lease_ttl: float = 10.0,
+        epoch: int = 1,
     ):
         self.engine = engine
         self.node_id = node_id or f"prefill-{uuid.uuid4().hex[:8]}"
@@ -65,6 +66,7 @@ class PrefillWorker:
         self.host, self.relay_port = host, relay_port
         self.dcfg = disagg_cfg or DisaggConfig()
         self.lease_ttl = lease_ttl
+        self.epoch = int(epoch)  # incarnation number (lease fencing)
         self.metrics = engine.metrics
         self._stop = threading.Event()
         # Directory load hint: the consume thread counts in-flight prefills,
@@ -91,13 +93,13 @@ class PrefillWorker:
         )
         self._health_thread.start()
 
-    def _register(self) -> None:
+    def _register(self) -> bool:
         # A prefill worker holds the FULL model (it runs whole-prompt
         # prefill), so its advertised range is every layer; the role keeps
         # it out of decode routes regardless.
-        self._directory.register(
+        return self._directory.register(
             self.node_id, 0, self.engine.cfg.num_layers - 1, self.queue,
-            ttl=self.lease_ttl, role="prefill",
+            ttl=self.lease_ttl, role="prefill", epoch=self.epoch,
         )
 
     # -- serve loop -----------------------------------------------------------
@@ -177,10 +179,15 @@ class PrefillWorker:
                 with self._busy_lock:
                     load = self._busy
                 alive = self._directory.heartbeat(
-                    self.node_id, load=load, ttl=self.lease_ttl
+                    self.node_id, load=load, ttl=self.lease_ttl,
+                    epoch=self.epoch,
                 )
                 if not alive:  # lease lapsed (e.g. directory restart)
-                    self._register()
+                    if not self._register():
+                        # Fenced: a gateway declared this incarnation dead.
+                        # Stop serving rather than split-brain the pool.
+                        self._stop.set()
+                        return
             except Exception:
                 continue  # transient control-plane failure: keep serving
 

@@ -28,6 +28,9 @@ Fault classes (``FaultRule.kind``):
 ``truncate``      first half of the frame is sent, then the connection severs
 ``corrupt``       one payload byte is flipped (seeded choice); CRC catches it
 ``sever``         connection is closed mid-operation; frame is not forwarded
+``crash``         whole-node death: every connection through the proxy severs
+                  AND new ones are refused, so data frames and heartbeats
+                  stop together (lease-expiry failure detection is testable)
 ================  ============================================================
 
 CANCEL frames and the 8-byte cancel-ack sentinel are control traffic and
@@ -50,7 +53,8 @@ from .relay import CANCEL_ACK, OP_CANCEL, OP_GET, OP_PING, OP_PUT, RelayClient
 
 __all__ = ["FaultRule", "FaultPlan", "ChaosProxy", "ChaosRelayClient"]
 
-KINDS = ("drop", "delay", "duplicate", "truncate", "corrupt", "sever")
+KINDS = ("drop", "delay", "duplicate", "truncate", "corrupt", "sever",
+         "crash")
 
 # Wire-direction op names a rule can match. ``put``/``get``/``ping`` are
 # client→hub requests; ``reply`` is any hub→client payload frame.
@@ -260,6 +264,13 @@ class _Pipe:
             dst.sendall(frame[: max(1, len(frame) // 2)])
             self.sever()
             raise ConnectionError("chaos: truncated frame")
+        if kind == "crash":
+            # Whole-node death: take down every connection riding this
+            # proxy (data stream AND the node's heartbeat/control dials)
+            # and refuse reconnects — the only recovery signal left is
+            # the directory lease expiring.
+            self.proxy.crash()
+            raise ConnectionError("chaos: node crashed")
         # sever (and corrupt on a payload-less frame, where there is
         # nothing under the CRC to flip): kill the connection.
         self.sever()
@@ -331,6 +342,11 @@ class ChaosProxy:
         self._plock = threading.Lock()
         # distcheck: unguarded-ok(atomic flag; accept loop tolerates stale)
         self._closed = False
+        # Set by crash(): the node this proxy fronts is "dead" — existing
+        # pipes are severed and new connections are accepted-then-closed
+        # (connection refused semantics without racing the accept loop).
+        # distcheck: unguarded-ok(atomic flag; accept loop tolerates stale)
+        self._crashed = False
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", port))
@@ -347,6 +363,9 @@ class ChaosProxy:
                 client, _ = self._listener.accept()
             except OSError:
                 return  # listener closed by stop()
+            if self._crashed:
+                client.close()  # dead node: refuse the dial
+                continue
             try:
                 pipe = _Pipe(self, client)
             except OSError:
@@ -368,6 +387,26 @@ class ChaosProxy:
             pipes = list(self._pipes)
         for p in pipes:
             p.sever()
+
+    def crash(self) -> None:
+        """Simulate whole-node death: sever every proxied connection AND
+        refuse new ones until :meth:`revive`. A node whose relay traffic
+        (data, directory heartbeats, everything) rides this proxy goes
+        dark exactly like a machine losing power — its lease then expires
+        on its own, which is the failure signal crash-recovery tests need
+        to exercise."""
+        self._crashed = True
+        self.sever_all()
+
+    @property
+    def crashed(self) -> bool:
+        return self._crashed
+
+    def revive(self) -> None:
+        """Undo :meth:`crash`: accept connections again (the 'zombie wakes
+        up' half of fencing tests — the node comes back, the fleet must
+        reject it)."""
+        self._crashed = False
 
     def stop(self) -> None:
         self._closed = True

@@ -45,6 +45,12 @@ class NodeInfo:
     # member), or "prefill" (prefill-only worker — excluded from layer
     # routes; disaggregated gateways pick it by role instead).
     role: str = "both"
+    # Monotonically increasing incarnation number the node picked when it
+    # (re)started. Registrations and heartbeats carrying an epoch OLDER
+    # than the table's are rejected — a partitioned zombie that wakes up
+    # after its sessions were migrated cannot re-enter the fleet under
+    # its stale identity (lease fencing).
+    epoch: int = 0
 
     def covers(self, layer: int) -> bool:
         return self.first_layer <= layer <= self.last_layer
@@ -58,16 +64,36 @@ class BlockDirectory:
         self.default_ttl = default_ttl
         self._nodes: Dict[str, NodeInfo] = {}
         self._lock = threading.Lock()
+        # node_id -> fence floor: epochs <= floor may never register or
+        # heartbeat again. Written by fence() when a gateway declares the
+        # node dead and migrates its sessions away.
+        self._fenced: Dict[str, int] = {}
+        # Plain observability counters (the directory embeds in the
+        # service process; scraping happens via snapshots, not Metrics).
+        self.fenced_rejections = 0
+        self.stale_heartbeats = 0
 
     def register(
         self, node_id: str, first_layer: int, last_layer: int, queue: str,
-        ttl: Optional[float] = None, role: str = "both",
-    ) -> None:
+        ttl: Optional[float] = None, role: str = "both", epoch: int = 0,
+    ) -> bool:
+        """Returns ``True`` when the lease was granted. ``False`` means the
+        registration was FENCED: the epoch is at or below this node_id's
+        fence floor, or older than the incarnation already holding the
+        lease — the caller is a zombie and must stop serving."""
         if last_layer < first_layer:
             raise ValueError(f"bad layer range [{first_layer}, {last_layer}]")
         if role not in ("both", "decode", "prefill"):
             raise ValueError(f"bad role {role!r}")
+        epoch = int(epoch)
         with self._lock:
+            if epoch <= self._fenced.get(node_id, -1):
+                self.fenced_rejections += 1
+                return False
+            cur = self._nodes.get(node_id)
+            if cur is not None and not cur.pending and epoch < cur.epoch:
+                self.fenced_rejections += 1
+                return False
             # A real node arriving retires ONE matching pending reservation
             # immediately (the provisional lease assign() parked on this
             # range): leaving it to TTL out would double-count the range in
@@ -95,14 +121,23 @@ class BlockDirectory:
             self._nodes[node_id] = NodeInfo(
                 node_id, first_layer, last_layer, queue,
                 time.monotonic() + (ttl or self.default_ttl),
-                role=role,
+                role=role, epoch=epoch,
             )
+            return True
 
-    def heartbeat(self, node_id: str, load: int = 0, ttl: Optional[float] = None) -> bool:
+    def heartbeat(self, node_id: str, load: int = 0,
+                  ttl: Optional[float] = None,
+                  epoch: Optional[int] = None) -> bool:
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None:
                 return False  # lease already expired: node must re-register
+            if epoch is not None and int(epoch) != info.epoch:
+                # A different incarnation holds the lease now (or the
+                # caller never re-registered after fencing): refuse the
+                # renewal so the zombie learns it is no longer a member.
+                self.stale_heartbeats += 1
+                return False
             info.lease_expiry = time.monotonic() + (ttl or self.default_ttl)
             info.load = load
             return True
@@ -110,6 +145,27 @@ class BlockDirectory:
     def remove(self, node_id: str) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
+
+    def fence(self, node_id: str, epoch: Optional[int] = None) -> int:
+        """Evict ``node_id`` and bar its current incarnation from ever
+        re-joining: the fence floor becomes ``max(floor, epoch)`` (default:
+        the epoch holding the lease right now). A genuinely restarted node
+        re-registers above the floor with a fresh, higher epoch. Returns
+        the new floor. Called by gateways before migrating the node's
+        sessions — after this, a partitioned zombie's register/heartbeat
+        both return False, so it can never serve (or corrupt) a stream
+        that now lives elsewhere."""
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+            floor = self._fenced.get(node_id, -1)
+            if epoch is not None:
+                floor = max(floor, int(epoch))
+            elif info is not None:
+                floor = max(floor, info.epoch)
+            else:
+                floor = max(floor, 0)
+            self._fenced[node_id] = floor
+            return floor
 
     def _expire_locked(self) -> None:
         now = time.monotonic()
@@ -244,17 +300,22 @@ class DirectoryService:
         try:
             op = req["op"]
             if op == "register":
-                d.register(req["node_id"], req["first_layer"],
-                           req["last_layer"], req["queue"], req.get("ttl"),
-                           req.get("role", "both"))
-                return {"ok": True}
+                accepted = d.register(
+                    req["node_id"], req["first_layer"],
+                    req["last_layer"], req["queue"], req.get("ttl"),
+                    req.get("role", "both"), req.get("epoch", 0),
+                )
+                return {"ok": True, "accepted": accepted}
             if op == "heartbeat":
                 ok = d.heartbeat(req["node_id"], req.get("load", 0),
-                                 req.get("ttl"))
+                                 req.get("ttl"), req.get("epoch"))
                 return {"ok": ok}
             if op == "remove":
                 d.remove(req["node_id"])
                 return {"ok": True}
+            if op == "fence":
+                floor = d.fence(req["node_id"], req.get("epoch"))
+                return {"ok": True, "floor": floor}
             if op == "assign":
                 first, last = d.assign(
                     req["num_layers"], req.get("span"),
@@ -272,7 +333,8 @@ class DirectoryService:
                 return {"ok": True, "nodes": [
                     {"node_id": n.node_id, "first_layer": n.first_layer,
                      "last_layer": n.last_layer, "queue": n.queue,
-                     "load": n.load, "pending": n.pending, "role": n.role}
+                     "load": n.load, "pending": n.pending, "role": n.role,
+                     "epoch": n.epoch}
                     for n in d.alive()
                 ]}
             return {"ok": False, "error": f"unknown op {op!r}"}
@@ -326,18 +388,29 @@ class DirectoryClient:
 
     def register(self, node_id: str, first_layer: int, last_layer: int,
                  queue: str, ttl: Optional[float] = None,
-                 role: str = "both") -> None:
-        self._call({"op": "register", "node_id": node_id,
-                    "first_layer": first_layer, "last_layer": last_layer,
-                    "queue": queue, "ttl": ttl, "role": role})
+                 role: str = "both", epoch: int = 0) -> bool:
+        """Returns ``True`` when the lease was granted, ``False`` when the
+        registration was fenced (stale epoch) — the caller must stop
+        serving under this identity."""
+        r = self._call({"op": "register", "node_id": node_id,
+                        "first_layer": first_layer, "last_layer": last_layer,
+                        "queue": queue, "ttl": ttl, "role": role,
+                        "epoch": epoch})
+        return bool(r.get("accepted", True))
 
     def heartbeat(self, node_id: str, load: int = 0,
-                  ttl: Optional[float] = None) -> bool:
+                  ttl: Optional[float] = None,
+                  epoch: Optional[int] = None) -> bool:
         return self._call({"op": "heartbeat", "node_id": node_id,
-                           "load": load, "ttl": ttl})["ok"]
+                           "load": load, "ttl": ttl, "epoch": epoch})["ok"]
 
     def remove(self, node_id: str) -> None:
         self._call({"op": "remove", "node_id": node_id})
+
+    def fence(self, node_id: str, epoch: Optional[int] = None) -> int:
+        """Evict and fence a node (see :meth:`BlockDirectory.fence`)."""
+        return self._call({"op": "fence", "node_id": node_id,
+                           "epoch": epoch})["floor"]
 
     def route(self, num_layers: int) -> List[dict]:
         return self._call({"op": "route", "num_layers": num_layers})["route"]

@@ -72,11 +72,16 @@ class ServingNode:
         cache_cfg=None,
         mesh_cfg=None,
         pool_max_batch: Optional[int] = None,
+        epoch: int = 1,
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.queue = f"block.{self.node_id}"
         self.host, self.relay_port = host, relay_port
         self.heartbeat_s, self.lease_ttl = heartbeat_s, lease_ttl
+        # Incarnation number for lease fencing: a restart must register
+        # with a HIGHER epoch than any previous life of this node_id, or
+        # the directory (rightly) treats it as a zombie.
+        self.epoch = int(epoch)
         kw = {} if dtype is None else {"dtype": dtype}
         self.backend = BlockBackend(
             cfg, layer_params, first_layer, last_layer, max_sessions,
@@ -103,10 +108,14 @@ class ServingNode:
         # pool thread or relay sockets (there is no node object to stop()).
         self._directory = DirectoryClient(relay_port, host)
         try:
-            self._directory.register(
+            if not self._directory.register(
                 self.node_id, first_layer, last_layer, self.queue,
-                ttl=lease_ttl,
-            )
+                ttl=lease_ttl, epoch=self.epoch,
+            ):
+                raise RuntimeError(
+                    f"registration fenced: node {self.node_id} epoch "
+                    f"{self.epoch} is stale — restart with a higher epoch"
+                )
             # All backend work flows through the task pool (one thread): N
             # concurrent sessions' compatible hops (same op + padded length)
             # group into ONE batched device call instead of N serial ones,
@@ -331,14 +340,20 @@ class ServingNode:
                 return
             try:
                 alive = self._directory.heartbeat(
-                    self.node_id, load=self.backend.load, ttl=self.lease_ttl
+                    self.node_id, load=self.backend.load,
+                    ttl=self.lease_ttl, epoch=self.epoch,
                 )
                 if not alive:  # lease lapsed (e.g. directory restart)
-                    self._directory.register(
+                    if not self._directory.register(
                         self.node_id, self.backend.first_layer,
                         self.backend.last_layer, self.queue,
-                        ttl=self.lease_ttl,
-                    )
+                        ttl=self.lease_ttl, epoch=self.epoch,
+                    ):
+                        # Fenced: this incarnation was declared dead and
+                        # its work re-homed. Serving on would split-brain
+                        # the fleet — wind the node down instead.
+                        self._stop.set()
+                        return
             except (ConnectionError, OSError, TimeoutError, RuntimeError):
                 continue
             if not self._consume_thread.is_alive():

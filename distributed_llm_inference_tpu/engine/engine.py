@@ -1263,15 +1263,18 @@ class InferenceEngine:
                         pass
                 self.sessions.pop(s.generation_id, None)
 
-    def export_kv_row(self, s: Session):
-        """Contiguous host copies of a resident session's prompt KV in the
+    def export_kv_row(self, s: Session, n: Optional[int] = None):
+        """Contiguous host copies of a resident session's KV in the
         STORED representation (so a same-config importer is bit-exact):
         value planes ``[L, S, Hkv, D]`` under ``"k"``/``"v"`` — bf16 (or
         engine dtype) for value caches, int8 for quantized ones, the
         latter alongside f32 scale planes ``[L, S, Hkv]`` under
-        ``"ks"``/``"vs"``. ``S = len(s.prompt)``; keys are post-RoPE, as
-        cached. Caller holds the scheduler lock (or owns the engine)."""
-        n = len(s.prompt)
+        ``"ks"``/``"vs"``. ``S = n`` tokens from position 0 — the default
+        ``len(s.prompt)`` covers the prompt (disagg prefill export);
+        session checkpoints pass ``total_len - 1`` to take the decoded
+        tail too. Keys are post-RoPE, as cached. Caller holds the
+        scheduler lock (or owns the engine)."""
+        n = len(s.prompt) if n is None else int(n)
         cache = self.cache
         if isinstance(cache, PagedKVCache):
             pages = jnp.asarray(np.asarray(s.pages, np.int32))
@@ -1443,6 +1446,201 @@ class InferenceEngine:
                     s, first, np.asarray(prompt, np.int32),
                     self._ext_produced, n,
                 )
+            return s.generation_id
+
+    # -- session checkpoint / migration (crash recovery) ----------------------
+
+    def export_session(self, generation_id: str):
+        """Snapshot a RESIDENT mid-decode session for migration to another
+        engine: host KV planes for its first ``total_len - 1`` positions
+        (prompt + ``generated[:-1]`` — the KV-after-decode invariant: the
+        last generated token is the next decode input and has no cache
+        entry yet), the generated-token tail, sampling options, and the
+        engine's RNG key state, all JSON/codec-friendly (planes excepted).
+
+        The in-flight pipelined tick (and any overlapped admissions) is
+        drained first so device KV and host bookkeeping agree — drained
+        tokens land in ``_ext_produced`` and reach consumers through the
+        next ``step()``, so none are lost. Checkpoints therefore always
+        sit on a tick boundary, which is what makes a resumed engine's
+        RNG-key consumption realign with the source's (byte-exact resume
+        contract; see :meth:`resume_session`).
+
+        Returns ``None`` when the session is unknown, not resident, or
+        finished during the drain (the terminal event is already on its
+        way to the consumer — nothing to migrate)."""
+        with self._lock:
+            s = self.sessions.get(generation_id)
+            if s is None or s.state != SessionState.ACTIVE:
+                return None
+            prev, self._pending = self._pending, None
+            if prev is not None or self._inflight_admits:
+                self._resolve_pending(self._ext_produced, prev)
+            if s.state != SessionState.ACTIVE or s.slot is None:
+                return None
+            if not s.generated:
+                return None  # no committed token yet — nothing to anchor on
+            planes = self.export_kv_row(s, s.total_len - 1)
+            snapshot = {
+                "prompt": list(s.prompt),
+                "generated": list(s.generated),
+                "options": dataclasses.asdict(s.options),
+                "rng": np.asarray(self.rng).tolist(),
+                "resumes": s.resumes,
+                "planes": planes,
+            }
+            self.metrics.counter("sessions_exported")
+            return snapshot
+
+    def resume_session(
+        self,
+        snapshot,
+        deadline: Optional[float] = None,
+    ) -> Optional[str]:
+        """Re-admit a session exported by :meth:`export_session` and keep
+        decoding from its exact position: ingest KV for
+        ``len(prompt) + len(generated) - 1`` tokens, publish the session
+        with its original prompt/generated split (prefix-cache keys cover
+        prompt pages only), and let the next tick feed ``last_token`` —
+        no token is emitted here, decode simply continues.
+
+        Byte-exact resume contract: when this engine is QUIET (no other
+        resident/waiting sessions, no tick in flight) the snapshot's RNG
+        key replaces the engine's, so with the same model/config/batch
+        the continued sample stream is bit-identical to the source
+        engine's — the gateway's recovery replay depends on this. On a
+        busy engine the RNG is left alone (greedy streams stay exact;
+        sampled ones continue from this engine's key sequence).
+
+        Returns the new generation_id, ``None`` on slot/page pressure
+        (caller retries elsewhere), and raises ``ValueError`` on
+        structural mismatch (quantization/shape/cache family) or a
+        snapshot that is already complete."""
+        if isinstance(self.cache, _SINK_KINDS):
+            raise ValueError("session resume unsupported for sink caches")
+        if self.mesh is not None:
+            raise ValueError("session resume is single-device only")
+        if self.draft is not None:
+            raise ValueError("session resume incompatible with a draft model")
+        prompt = [int(t) for t in snapshot["prompt"]]
+        generated = [int(t) for t in snapshot["generated"]]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if not generated:
+            raise ValueError("snapshot carries no generated tokens")
+        opts = snapshot.get("options")
+        if isinstance(opts, dict):
+            known = {f.name for f in dataclasses.fields(SamplingOptions)}
+            opts = SamplingOptions(
+                **{k: v for k, v in opts.items() if k in known}
+            )
+        options = opts or SamplingOptions()
+        if len(generated) >= options.max_new_tokens:
+            raise ValueError("snapshot is already at max_new_tokens")
+        if options.eos_token_id >= 0 and generated[-1] == options.eos_token_id:
+            raise ValueError("snapshot already ended at eos")
+        planes = snapshot["planes"]
+        n = len(prompt) + len(generated) - 1
+        quant = isinstance(
+            self.cache, (QuantizedPagedKVCache, QuantizedDenseKVCache)
+        )
+        want = {"k", "v", "ks", "vs"} if quant else {"k", "v"}
+        if set(planes) != want:
+            raise ValueError(
+                f"KV planes {sorted(planes)} do not match this cache "
+                f"(want {sorted(want)}: quantization must agree across pools)"
+            )
+        shape = (
+            self.cfg.num_layers, n, self.cfg.num_kv_heads, self.cfg.head_dim,
+        )
+        for name in sorted(want):
+            expect = shape if name in ("k", "v") else shape[:3]
+            got = tuple(np.asarray(planes[name]).shape)
+            if got != expect:
+                raise ValueError(
+                    f"KV plane {name!r} shape {got} != expected {expect}"
+                )
+        limit = (
+            self.ecfg.max_seq_len
+            if isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
+            else self.ccfg.max_pages_per_session * self.ccfg.page_size
+        )
+        if n + 1 > limit:
+            raise ValueError(
+                "snapshot exceeds this engine's per-session capacity"
+            )
+        dev = {name: jnp.asarray(planes[name])[:, None] for name in want}
+        with self._lock:
+            slot = next(
+                (i for i in range(self.batch) if self.slots[i] is None), None
+            )
+            if slot is None:
+                return None
+            quiet = (
+                not self.waiting
+                and not self._inflight_admits
+                and self._pending is None
+                and all(g is None for g in self.slots)
+            )
+            s = Session(
+                prompt=prompt,
+                options=options,
+                deadline=deadline,
+                generated=generated,
+            )
+            s.disagg = True
+            s.resumes = int(snapshot.get("resumes", 0)) + 1
+            self._ensure_capacity(n + 1)
+            self.cache = self.cache.reset_rows(jnp.arange(self.batch) == slot)
+            if isinstance(self.cache, PagedKVCache):
+                ps = self.ccfg.page_size
+                need = math.ceil((n + 1) / ps)
+                if need > self.allocator.free_count:
+                    return None  # pool pressure: same signal as a full batch
+                s.pages = self.allocator.alloc(need)
+                try:
+                    for i, pg in enumerate(s.pages):
+                        self._queue_install(slot, i, pg)
+                    self._flush_installs()
+                    sub = self.cache.select_row(slot)
+                    if quant:
+                        sub = sub.ingest_planes_row(
+                            dev["k"], dev["v"], dev["ks"], dev["vs"], n
+                        )
+                    else:
+                        sub = sub.ingest_row(dev["k"], dev["v"], n)
+                    self.cache = self.cache.merge_row(sub, slot)
+                    if self.ccfg.prefix_caching:
+                        # Only prompt-covered pages are content-addressable;
+                        # generated-tail pages depend on sampling.
+                        s.prefix_keys = PageAllocator.chain_keys(prompt, ps)
+                        for i, key in enumerate(s.prefix_keys):
+                            self.allocator.register(s.pages[i], key)
+                except BaseException:
+                    self.allocator.free(s.pages)
+                    s.pages = []
+                    s.prefix_keys = []
+                    raise
+            else:
+                sub = self.cache.select_row(slot)
+                if quant:
+                    sub = sub.ingest_planes_row(
+                        dev["k"], dev["v"], dev["ks"], dev["vs"], n
+                    )
+                else:
+                    sub = sub.ingest_row(dev["k"], dev["v"], n)
+                self.cache = self.cache.merge_row(sub, slot)
+            self.sessions[s.generation_id] = s
+            s.slot = slot
+            s.state = SessionState.ACTIVE
+            self.slots[slot] = s.generation_id
+            self._carry_ok[slot] = False  # next tick feeds last_token fresh
+            if quiet and snapshot.get("rng") is not None:
+                self.rng = jnp.asarray(
+                    np.asarray(snapshot["rng"], dtype=np.uint32)
+                )
+            self.metrics.counter("sessions_submitted")
+            self.metrics.counter("sessions_resumed")
             return s.generation_id
 
     # -- scheduling internals -------------------------------------------------

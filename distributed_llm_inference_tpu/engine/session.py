@@ -65,6 +65,10 @@ class Session:
     # accounting splits into prefill-side (gateway-observed) and
     # decode-side (this session's submit→first-token) components.
     disagg: bool = False
+    # How many times this logical stream has been re-admitted from a
+    # snapshot (engine.resume_session). Carried through checkpoints so a
+    # twice-migrated session reports 2, not 1.
+    resumes: int = 0
     # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

@@ -18,6 +18,7 @@ from .backends import (
     ClientBackend,
     DisaggBackend,
     EngineBackend,
+    FleetBackend,
     Handle,
     TokenEvent,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "ClientBackend",
     "DisaggBackend",
     "EngineBackend",
+    "FleetBackend",
     "Handle",
     "TokenEvent",
 ]

@@ -10,6 +10,12 @@ One protocol, two implementations:
 * :class:`ClientBackend` — the relay-tier :class:`DistributedClient`.
   Each request runs ``client.generate`` on its own thread (the client is
   thread-safe per-call) with the streaming/cancel hooks.
+* :class:`FleetBackend` — the crash-recoverable decode fleet: requests
+  stream from a :class:`~..disagg.decode_node.DecodeNode` as
+  sequence-stamped ``migrate.tok`` frames; on node death mid-stream the
+  gateway fences the node's directory lease and resumes the session on a
+  healthy node from its last shipped checkpoint, deduplicating replayed
+  tokens by sequence index so the client sees each token exactly once.
 
 Both expose the same surface the server consumes: ``start(loop)``,
 ``submit(prompt, options, deadline) -> Handle``, ``cancel(handle)``,
@@ -43,6 +49,12 @@ class TokenEvent:
     token: int
     finished: bool
     finish_reason: Optional[str] = None
+    # Exactly-once bookkeeping (FleetBackend): the token's index in the
+    # generated sequence, and how many times the stream was re-homed onto
+    # another node. Backends without recovery leave the defaults; the SSE
+    # layer then stamps ``seq`` itself from a local counter.
+    seq: Optional[int] = None
+    resumed: int = 0
 
 
 @dataclasses.dataclass(eq=False)  # identity-hashed: handles live in sets
@@ -692,3 +704,409 @@ class ClientBackend(Backend):
             threads = list(self._threads.values())
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+class FleetBackend(Backend):
+    """Crash-recoverable decode-fleet backend.
+
+    Each request runs on its own thread: pick the least-loaded live
+    ``role="decode"`` node from the block directory, send a
+    ``migrate.submit`` op, and forward the node's sequence-stamped
+    ``migrate.tok`` frames to the request's stream. The node also ships
+    periodic session checkpoints (``migrate.ckpt`` kv_codec frames);
+    the gateway keeps the latest COMPLETE one raw — validation is the
+    resume target's job.
+
+    Death detection: a silent stream for ``dead_after_s`` (default: the
+    lease TTL) combined with the node missing from the directory's
+    ``alive()`` view (or re-registered under a different epoch) declares
+    the node dead. Recovery then: fence the incarnation in the directory
+    (so a zombie can never re-register with its stale epoch), pick a
+    healthy node, and either replay the checkpoint (``migrate.resume``
+    with the delivered-token cursor — the node re-emits any undelivered
+    checkpoint tail and regenerates the rest deterministically) or, with
+    no checkpoint yet, resubmit the prompt cold. Every frame carries the
+    attempt tag ``att``; frames from a fenced attempt are dropped
+    (``stale_frames_fenced``), and replayed tokens whose sequence index
+    precedes the delivered cursor are suppressed (``tokens_deduped``) —
+    together: exactly-once delivery, zero token loss.
+
+    Bounded: at most ``resume_max_attempts`` re-homes per request, and a
+    resume is shed (``resume_shed``) when the request's remaining
+    deadline is under ``shed_headroom_s`` x the number of concurrent
+    recoveries — a recovery storm must not burn decode on streams that
+    cannot finish in time.
+    """
+
+    def __init__(
+        self,
+        relay_port: int,
+        relay_host: str = "127.0.0.1",
+        disagg_cfg: Optional[DisaggConfig] = None,
+        metrics: Optional[Metrics] = None,
+        pool_wait_s: float = 2.0,
+    ):
+        self.relay_host, self.relay_port = relay_host, relay_port
+        self.dcfg = disagg_cfg or DisaggConfig()
+        self.metrics = metrics or Metrics()
+        self._dead_after = self.dcfg.dead_after_s or self.dcfg.lease_ttl_s
+        self._pool_wait_s = pool_wait_s
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._tlock = threading.Lock()
+        self._threads: Dict[str, threading.Thread] = {}
+        # Concurrent-recovery census for the shed heuristic: each extra
+        # stream mid-recovery inflates the headroom a resume must clear.
+        self._rec_lock = threading.Lock()
+        self._recovering = 0
+        self._stop_evt = threading.Event()
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+
+    def submit(self, prompt, options, deadline) -> Handle:
+        if self._stop_evt.is_set():
+            raise RuntimeError("backend is stopping")
+        key = f"fleet-{uuid.uuid4().hex[:12]}"
+        h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event())
+        t = threading.Thread(
+            target=self._run_fleet,
+            args=(h, key, list(prompt), options, deadline),
+            name=key, daemon=True,
+        )
+        with self._tlock:
+            self._threads[key] = t
+        t.start()
+        return h
+
+    def cancel(self, handle: Handle) -> None:
+        if handle.stop is not None:
+            handle.stop.set()
+
+    def active_sessions(self) -> int:
+        with self._tlock:
+            return len(self._threads)
+
+    def queue_depth(self) -> int:
+        return 0  # admission happens downstream, on the decode nodes
+
+    def probe(self) -> bool:
+        from ..distributed.directory import DirectoryClient
+
+        try:
+            with DirectoryClient(self.relay_port, self.relay_host) as d:
+                return any(
+                    n.get("role") == "decode" and not n.get("pending")
+                    for n in d.alive()
+                )
+        except Exception:  # noqa: BLE001 - any failure means unhealthy
+            return False
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_evt.set()
+        end = time.monotonic() + timeout
+        with self._tlock:
+            threads = list(self._threads.values())
+        for t in threads:
+            t.join(timeout=max(0.0, end - time.monotonic()))
+
+    # -- per-request stream loop -------------------------------------------
+
+    def _emit(self, h: Handle, ev: TokenEvent) -> None:
+        try:
+            self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)
+        except RuntimeError:
+            pass  # loop already closed (server exited mid-stream)
+
+    def _run_fleet(self, h, key, prompt, options, deadline) -> None:
+        from ..distributed.directory import DirectoryClient
+        from ..distributed.messages import pack_frame, unpack_frame
+        from ..distributed.relay import RelayClient
+
+        reply = f"fleet.tok.{uuid.uuid4().hex[:12]}"
+        delivered = 0  # exactly-once cursor: next sequence index to accept
+        resumed = 0
+        attempt = 0
+        att = f"{key}#0"  # fences frames from superseded attempts
+        ckpt: Optional[List[bytes]] = None  # latest complete checkpoint
+        partial: List[bytes] = []
+        dead_ids: set = set()
+        node: Optional[dict] = None
+        t_detect: Optional[float] = None  # death detection time (MTTR)
+        in_recovery = False
+        fail: Optional[str] = None
+        finished = False
+        cancel_sent: Optional[float] = None
+        # Fresh relay/directory clients per request: neither is
+        # thread-safe, and request threads must not serialize on a socket.
+        client = RelayClient(self.relay_host, self.relay_port)
+        try:
+            directory = DirectoryClient(self.relay_port, self.relay_host)
+        except BaseException:
+            client.close()
+            raise
+
+        def enter_recovery() -> None:
+            nonlocal in_recovery
+            if not in_recovery:
+                in_recovery = True
+                with self._rec_lock:
+                    self._recovering += 1
+
+        def exit_recovery() -> None:
+            nonlocal in_recovery
+            if in_recovery:
+                in_recovery = False
+                with self._rec_lock:
+                    self._recovering -= 1
+
+        def remaining_s() -> Optional[float]:
+            if deadline is None:
+                return None
+            return max(deadline - time.monotonic(), 0.0)
+
+        def dispatch(n: dict) -> None:
+            """Send this attempt to node ``n``: checkpoint replay when we
+            have one, cold prompt resubmission otherwise."""
+            if ckpt:
+                kvq = f"fleet.kv.{uuid.uuid4().hex[:12]}"
+                client.put_many((kvq, f) for f in ckpt)
+                client.put(n["queue"], pack_frame({
+                    "op": "migrate.resume", "gen": key, "reply": reply,
+                    "att": att, "kv": kvq, "nf": len(ckpt),
+                    "from": delivered, "deadline_s": remaining_s(),
+                }))
+            else:
+                client.put(n["queue"], pack_frame({
+                    "op": "migrate.submit", "gen": key, "reply": reply,
+                    "att": att, "prompt": prompt,
+                    "options": dataclasses.asdict(options),
+                    "deadline_s": remaining_s(),
+                }))
+
+        def pick(wait_s: float) -> Optional[dict]:
+            end = time.monotonic() + wait_s
+            while True:
+                try:
+                    nodes = [
+                        n for n in directory.alive()
+                        if n.get("role") == "decode"
+                        and not n.get("pending")
+                        and n.get("node_id") not in dead_ids
+                    ]
+                except Exception:  # noqa: BLE001 - directory blip
+                    nodes = []
+                if nodes:
+                    return min(nodes, key=lambda n: n.get("load", 0))
+                if (time.monotonic() >= end or self._stop_evt.is_set()
+                        or h.stop.is_set()):
+                    return None
+                time.sleep(0.05)
+
+        def node_alive() -> bool:
+            if node is None:
+                return False
+            try:
+                rows = directory.alive()
+            except Exception:  # noqa: BLE001
+                # Directory unreachable says nothing about the node:
+                # don't trigger a (possibly destructive) fence on a
+                # control-plane blip.
+                return True
+            for r in rows:
+                if r.get("node_id") == node.get("node_id"):
+                    # Same name, different epoch = a NEW incarnation;
+                    # the one serving this stream is gone.
+                    return r.get("epoch") == node.get("epoch")
+            return False
+
+        def recover(fence: bool) -> bool:
+            """Re-home the stream. Returns False with ``fail`` set when
+            the request is out of road (budget, deadline, empty pool)."""
+            nonlocal node, att, attempt, t_detect, partial, fail
+            enter_recovery()
+            if t_detect is None:
+                t_detect = time.monotonic()
+            if fence:
+                self.metrics.counter("node_deaths_detected")
+                if node is not None:
+                    dead_ids.add(node.get("node_id"))
+                    try:
+                        directory.fence(
+                            node.get("node_id"), node.get("epoch")
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass  # lease expiry fences the zombie for us
+            attempt += 1
+            if attempt > self.dcfg.resume_max_attempts:
+                self.metrics.counter("resume_failures")
+                fail = "error: resume attempts exhausted"
+                return False
+            rem = remaining_s()
+            if rem is not None:
+                with self._rec_lock:
+                    storm = self._recovering
+                if rem < self.dcfg.shed_headroom_s * max(1, storm):
+                    self.metrics.counter("resume_shed")
+                    fail = "shed"
+                    return False
+            self.metrics.counter("resume_attempts")
+            partial = []  # a half-shipped checkpoint dies with its node
+            wait = self._dead_after
+            if rem is not None:
+                wait = min(wait, rem)
+            nxt = pick(wait)
+            if nxt is None:
+                self.metrics.counter("resume_failures")
+                fail = "error: no decode node available"
+                return False
+            node = nxt
+            att = f"{key}#{attempt}"
+            try:
+                dispatch(node)
+            except (ConnectionError, OSError):
+                self.metrics.counter("resume_failures")
+                fail = "error: relay lost"
+                return False
+            return True
+
+        try:
+            node = pick(self._pool_wait_s)
+            if node is None:
+                fail = "error: no decode node registered"
+                return
+            try:
+                dispatch(node)
+            except (ConnectionError, OSError):
+                fail = "error: relay lost"
+                return
+            last_frame = time.monotonic()
+            while True:
+                if self._stop_evt.is_set():
+                    fail = "cancelled"
+                    return
+                now = time.monotonic()
+                if h.stop.is_set():
+                    if cancel_sent is None:
+                        cancel_sent = now
+                        try:
+                            client.put(node["queue"], pack_frame(
+                                {"op": "migrate.cancel", "gen": key}
+                            ))
+                        except (ConnectionError, OSError):
+                            fail = "cancelled"
+                            return
+                    elif now - cancel_sent > 2.0:
+                        fail = "cancelled"  # node never acked — give up
+                        return
+                if deadline is not None and now >= deadline:
+                    try:
+                        client.put(node["queue"], pack_frame(
+                            {"op": "migrate.cancel", "gen": key}
+                        ))
+                    except (ConnectionError, OSError):
+                        pass
+                    fail = "deadline"
+                    return
+                try:
+                    frame = client.get(reply, timeout=0.2)
+                except TimeoutError:
+                    if (time.monotonic() - last_frame >= self._dead_after
+                            and not node_alive()):
+                        if not recover(True):
+                            return
+                        last_frame = time.monotonic()
+                    continue
+                except (ConnectionError, OSError):
+                    fail = "error: relay lost"
+                    return
+                last_frame = time.monotonic()
+                try:
+                    header, _ = unpack_frame(frame)
+                except Exception:  # noqa: BLE001
+                    self.metrics.counter("malformed_frames")
+                    continue
+                if header.get("att") != att:
+                    self.metrics.counter("stale_frames_fenced")
+                    continue
+                op = header.get("op")
+                if op == "migrate.ckpt":
+                    # Single sender per attempt -> frames arrive in order;
+                    # keep only a COMPLETE set (a torn one can't resume).
+                    i, n = header.get("i"), header.get("n")
+                    partial = [frame] if i == 0 else partial + [frame]
+                    if isinstance(n, int) and i == n - 1 \
+                            and len(partial) == n:
+                        ckpt, partial = partial, []
+                    continue
+                if op == "migrate.err":
+                    # The node declined (pool pressure, bad transfer) but
+                    # is healthy: retry elsewhere without fencing it.
+                    if not recover(False):
+                        return
+                    last_frame = time.monotonic()
+                    continue
+                if op != "migrate.tok":
+                    self.metrics.counter("unknown_ops_dropped")
+                    continue
+                seq, tok = header.get("seq"), header.get("tok")
+                fin = bool(header.get("fin"))
+                reason = header.get("reason")
+                if tok is not None and int(tok) >= 0 and seq is not None:
+                    seq = int(seq)
+                    if seq == delivered:
+                        delivered += 1
+                        if t_detect is not None:
+                            self.metrics.observe(
+                                "mttr_ms",
+                                (time.monotonic() - t_detect) * 1e3,
+                            )
+                            t_detect = None
+                            resumed += 1
+                            exit_recovery()
+                        self._emit(h, TokenEvent(
+                            int(tok), fin, reason if fin else None,
+                            seq=seq, resumed=resumed,
+                        ))
+                        if fin:
+                            finished = True
+                            return
+                    elif seq < delivered:
+                        # Replayed prefix of a resumed stream: suppress —
+                        # the client already has this token.
+                        self.metrics.counter("tokens_deduped")
+                        if fin:
+                            self._emit(h, TokenEvent(
+                                -1, True, reason, resumed=resumed
+                            ))
+                            finished = True
+                            return
+                    else:
+                        # Sequence gap: the node lost state it already
+                        # streamed — its engine diverged. Re-home.
+                        if not recover(True):
+                            return
+                        last_frame = time.monotonic()
+                elif fin:  # finish without a token (cancel, deadline)
+                    self._emit(h, TokenEvent(
+                        -1, True, reason, resumed=resumed
+                    ))
+                    finished = True
+                    return
+        finally:
+            exit_recovery()
+            with self._tlock:
+                self._threads.pop(key, None)
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                directory.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if not finished and self._loop is not None:
+                # The stream still owes its consumer a terminal event.
+                self._emit(h, TokenEvent(
+                    -1, True, fail or "error: stream aborted",
+                    resumed=resumed,
+                ))

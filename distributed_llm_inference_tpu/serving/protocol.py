@@ -141,7 +141,18 @@ def completion_response(
     finish_reason: str,
     prompt_len: int,
     tokenizer=None,
+    resumed: int = 0,
 ) -> Dict[str, Any]:
+    usage = {
+        "prompt_tokens": prompt_len,
+        "completion_tokens": len(tokens),
+        "total_tokens": prompt_len + len(tokens),
+    }
+    if resumed:
+        # Extension: how many times the stream was re-homed onto another
+        # decode node mid-generation (crash recovery). Omitted for the
+        # common, uninterrupted case to keep the OpenAI shape exact.
+        usage["resumed"] = int(resumed)
     return {
         "id": req_id,
         "object": "text_completion",
@@ -154,11 +165,7 @@ def completion_response(
             "finish_reason": wire_finish_reason(finish_reason),
             "logprobs": None,
         }],
-        "usage": {
-            "prompt_tokens": prompt_len,
-            "completion_tokens": len(tokens),
-            "total_tokens": prompt_len + len(tokens),
-        },
+        "usage": usage,
     }
 
 
@@ -169,10 +176,12 @@ def completion_chunk(
     token: Optional[int],
     finish_reason: Optional[str],
     tokenizer=None,
+    usage: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One SSE chunk: a single fresh token, or the terminal chunk (no
-    token) carrying the finish_reason."""
-    return {
+    token) carrying the finish_reason — and, when provided, the final
+    ``usage`` block (token counts + the ``resumed`` recovery count)."""
+    chunk = {
         "id": req_id,
         "object": "text_completion",
         "created": created,
@@ -187,6 +196,9 @@ def completion_chunk(
             "logprobs": None,
         }],
     }
+    if usage is not None:
+        chunk["usage"] = usage
+    return chunk
 
 
 def error_body(message: str, err_type: str, code: Optional[str] = None) -> bytes:

@@ -376,12 +376,14 @@ class ApiServer:
                                submit_t, req_id, created) -> str:
         tokens = []
         reason = "timeout"
+        resumed = 0
         while True:
             ev = await self._next_event(
                 handle, deadline, not tokens, submit_t
             )
             if ev is None:
                 break
+            resumed = max(resumed, ev.resumed)
             if ev.token >= 0:
                 tokens.append(ev.token)
             if ev.finished:
@@ -390,7 +392,7 @@ class ApiServer:
         self.backend.metrics.counter("gateway_tokens", len(tokens))
         payload = json.dumps(completion_response(
             req_id, created, self.scfg.model_name, tokens, reason,
-            len(req.prompt), self.tokenizer,
+            len(req.prompt), self.tokenizer, resumed=resumed,
         )).encode()
         writer.write(_response("200 OK", payload))
         await writer.drain()
@@ -402,6 +404,7 @@ class ApiServer:
         await writer.drain()
         n_tokens = 0
         reason = "timeout"
+        resumed = 0
         try:
             while True:
                 ev = await self._next_event(
@@ -409,19 +412,30 @@ class ApiServer:
                 )
                 if ev is None:
                     break
+                resumed = max(resumed, ev.resumed)
                 if ev.token >= 0:
+                    # Every token chunk carries its sequence index: the
+                    # backend's (FleetBackend: survives a mid-stream node
+                    # recovery), else the local count — clients can detect
+                    # any duplicated or lost token either way.
+                    seq = ev.seq if ev.seq is not None else n_tokens
                     n_tokens += 1
                     writer.write(sse_event(completion_chunk(
                         req_id, created, self.scfg.model_name, ev.token,
                         None, self.tokenizer,
-                    )))
+                    ), seq=seq))
                     await writer.drain()
                 if ev.finished:
                     reason = ev.finish_reason or "stop"
                     break
             writer.write(sse_event(completion_chunk(
                 req_id, created, self.scfg.model_name, None, reason,
-                self.tokenizer,
+                self.tokenizer, usage={
+                    "prompt_tokens": len(req.prompt),
+                    "completion_tokens": n_tokens,
+                    "total_tokens": len(req.prompt) + n_tokens,
+                    "resumed": resumed,
+                },
             )))
             writer.write(SSE_DONE)
             await writer.drain()

@@ -10,13 +10,19 @@ of the stdlib-only server.
 from __future__ import annotations
 
 import json
-from typing import Any
+from typing import Any, Optional
 
 SSE_DONE = b"data: [DONE]\n\n"
 
 
-def sse_event(data: Any) -> bytes:
-    """One SSE frame: ``data: <compact json>\\n\\n``."""
+def sse_event(data: Any, seq: Optional[int] = None) -> bytes:
+    """One SSE frame: ``data: <compact json>\\n\\n``.
+
+    ``seq`` stamps a dict payload with the token's index in the generated
+    sequence — the exactly-once key a client can use to detect duplicated
+    or lost tokens across a mid-stream node recovery (FleetBackend)."""
+    if seq is not None and isinstance(data, dict):
+        data = dict(data, seq=int(seq))
     return b"data: " + json.dumps(data, separators=(",", ":")).encode() + b"\n\n"
 
 

@@ -94,6 +94,18 @@ METRICS = {
     "breaker_state": ("gauge", "0 closed / 1 open / 2 half-open"),
     "breaker_*_transitions": ("counter", "Breaker transitions into a state"),
     "breaker_failures_recorded": ("counter", "Failure signals seen"),
+    # session migration / crash recovery (migrate.* frame plane)
+    "sessions_exported": ("counter", "Mid-decode sessions snapshotted"),
+    "sessions_resumed": ("counter", "Sessions re-admitted from a snapshot"),
+    "checkpoints_shipped": ("counter", "Session checkpoints sent to gateway"),
+    "checkpoint_frames_sent": ("counter", "Checkpoint KV frames shipped"),
+    "node_deaths_detected": ("counter", "Decode nodes declared dead mid-stream"),
+    "resume_attempts": ("counter", "Stream migrations started after a death"),
+    "resume_failures": ("counter", "Streams failed after resume budget spent"),
+    "resume_shed": ("counter", "Resumes shed by deadline headroom"),
+    "tokens_deduped": ("counter", "Replayed tokens suppressed by seq dedup"),
+    "stale_frames_fenced": ("counter", "Frames dropped from fenced attempts"),
+    "mttr_ms": ("summary", "Death detection to first post-resume token"),
 }
 
 

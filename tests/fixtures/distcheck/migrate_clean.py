@@ -1,0 +1,60 @@
+"""Annotated twin of ``migrate_violation.py`` — expects NO findings.
+
+The unknown-op drop bumps a declared error counter, and the failed
+admission answers the gateway with a ``migrate.err`` reply frame before
+bailing — both paths keep the reply guarantee the real
+``disagg.decode_node.DecodeNode._consume`` loop honors. A ``Gateway``
+closes the frame-key world: it produces the request keys the consumer
+reads and consumes the error key the consumer produces.
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import (
+    pack_frame,
+    unpack_frame,
+)
+
+
+class Gateway:
+    def __init__(self, relay):
+        self.relay = relay
+
+    def send_submit(self, prompt):
+        self.relay.put("decode.n1", pack_frame({
+            "op": "migrate.submit", "gen": "g1", "att": "g1#0",
+            "reply": "fleet.tok.g1", "prompt": prompt,
+        }))
+
+    def on_reply(self, frame):
+        header, _ = unpack_frame(frame)
+        return header.get("error")
+
+
+class MigrationConsumer:
+    def __init__(self, relay, engine, metrics):
+        self.relay = relay
+        self.engine = engine
+        self.metrics = metrics
+        self._stopped = False
+
+    def _consume(self):
+        while not self._stopped:
+            try:
+                frame = self.relay.get("decode.n1", timeout=0.5)
+            except TimeoutError:
+                continue  # nothing consumed yet: exempt
+            header, _ = unpack_frame(frame)
+            op = header.get("op")
+            if op == "migrate.cancel":
+                self.engine.cancel(header.get("gen"))
+                continue  # distcheck: reply-ok(cancel acks ride the token stream)
+            if op not in ("migrate.submit", "migrate.resume"):
+                self.metrics.counter("unknown_ops_dropped")
+                continue  # counted: the drop is observable
+            try:
+                self.engine.submit(header.get("prompt"))
+            except Exception as e:
+                self.relay.put(header.get("reply"), pack_frame({
+                    "op": "migrate.err", "gen": header.get("gen"),
+                    "att": header.get("att"), "error": repr(e),
+                }))
+                return  # distcheck: reply-ok(migrate.err answered the gateway)

@@ -1,0 +1,40 @@
+"""Seeded reply-guarantee violations in a migration-frame consumer —
+distcheck fixture.
+
+The consumer drains a decode node's op queue (``migrate.submit`` /
+``migrate.resume`` / ``migrate.cancel``). A gateway that sent one of
+these is blocked on the reply queue: dropping the frame silently strands
+the stream until its death detector fires — exactly the hang DC130
+exists to catch.
+
+Expected findings:
+  DC130 x2  (silent return when admission fails; silent continue on an
+             unknown op)
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import unpack_frame
+
+
+class MigrationConsumer:
+    def __init__(self, relay, engine):
+        self.relay = relay
+        self.engine = engine
+        self._stopped = False
+
+    def _consume(self):
+        while not self._stopped:
+            try:
+                frame = self.relay.get("decode.n1", timeout=0.5)
+            except TimeoutError:
+                continue  # nothing consumed yet: exempt
+            header, _ = unpack_frame(frame)
+            op = header.get("op")
+            if op == "migrate.cancel":
+                self.engine.cancel(header.get("gen"))
+                continue  # distcheck: reply-ok(cancel acks ride the token stream)
+            if op not in ("migrate.submit", "migrate.resume"):
+                continue  # DC130: unknown op dropped, no reply, no counter
+            try:
+                self.engine.submit(header.get("prompt"))
+            except Exception:
+                return  # DC130: admission failed, requester never hears back

@@ -103,6 +103,10 @@ _EXPECTED = {
     "reply_violation.py": {
         "DC130": 2,  # silent bare return; silent continue, both post-decode
     },
+    "migrate_violation.py": {
+        "DC130": 2,  # migration consumer: silent unknown-op drop; silent
+        #              return on failed admission (gateway left hanging)
+    },
 }
 
 
@@ -127,6 +131,7 @@ _CLEAN = [
     "lockorder_clean.py",
     "lifecycle_clean.py",
     "reply_clean.py",
+    "migrate_clean.py",
 ]
 
 

@@ -1,0 +1,536 @@
+"""Session migration / crash recovery suite.
+
+Covers the node-loss survival subsystem end to end:
+
+* engine snapshots — ``export_session`` / ``resume_session`` round-trip
+  a mid-decode session (KV planes, RNG key, token tail) through the
+  ``encode_session`` codec BYTE-EXACT: the resumed stream's continuation
+  equals the uninterrupted run (greedy and sampled, dense and paged,
+  f32 and int8 KV), and malformed/complete snapshots are rejected;
+* lease fencing — stale-epoch registrations and heartbeats are refused,
+  ``fence`` floors rise monotonically, expired leases never appear in
+  ``assign``/``plan_route``, and a 30-iteration concurrent churn keeps
+  the table consistent;
+* chaos ``crash`` — the proxy kills data AND heartbeat paths together
+  and refuses reconnects until ``revive``;
+* the recovery gateway — ``FleetBackend`` over a real relay + two
+  ``DecodeNode`` pools: a node crashed mid-stream is fenced and the
+  stream resumes on the survivor with the client-visible token sequence
+  byte-exact vs an uninterrupted run (zero lost, zero duplicated);
+* the wire extensions — SSE chunks carry per-token sequence indexes and
+  the final usage block carries the resume count.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    DisaggConfig,
+    EngineConfig,
+    ModelConfig,
+)
+from distributed_llm_inference_tpu.disagg import DecodeNode
+from distributed_llm_inference_tpu.disagg.kv_codec import (
+    decode_session,
+    encode_kv,
+    encode_session,
+)
+from distributed_llm_inference_tpu.distributed.directory import (
+    BlockDirectory,
+    DirectoryService,
+)
+from distributed_llm_inference_tpu.distributed.relay import (
+    RelayServer,
+    native_available,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.serving import FleetBackend
+from distributed_llm_inference_tpu.serving.protocol import (
+    completion_chunk,
+    completion_response,
+)
+from distributed_llm_inference_tpu.serving.sse import sse_event
+
+pytestmark = pytest.mark.disagg
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ unavailable to build the native relay"
+)
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+COMBOS = [
+    ("paged", None, 0.0),
+    ("paged", "int8", 0.8),
+    ("dense", None, 0.8),
+    ("dense", "int8", 0.0),
+]
+
+
+def make_engine(kind="paged", kv_quant=None, batch=2):
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(max_batch_size=batch, prefill_buckets=(8, 16, 32),
+                     max_seq_len=64, dtype="float32"),
+        CacheConfig(kind=kind, kv_quant=kv_quant, page_size=8, num_pages=64,
+                    max_pages_per_session=8),
+    )
+
+
+def drain(engine, gid, budget_s=60.0):
+    toks = []
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        for g, tok, fin in engine.step():
+            if g != gid:
+                continue
+            if tok >= 0:
+                toks.append(tok)
+            if fin:
+                return toks
+    raise AssertionError("generation did not finish in budget")
+
+
+def run_partway(engine, gid, min_tokens):
+    """Step until ``gid`` has produced at least ``min_tokens`` (and assert
+    it has not finished — callers need a live session to export)."""
+    got = []
+    deadline = time.monotonic() + 60.0
+    while len(got) < min_tokens and time.monotonic() < deadline:
+        for g, tok, fin in engine.step():
+            if g != gid:
+                continue
+            if tok >= 0:
+                got.append(tok)
+            assert not fin, "session finished before the export point"
+    return got
+
+
+OPTS = dict(max_new_tokens=48)  # room for the in-flight-tick drain
+
+
+# -- engine snapshots ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind,kv_quant,temp", COMBOS)
+def test_export_resume_byte_exact(kind, kv_quant, temp):
+    """The tentpole contract: checkpoint mid-decode, ship through the
+    codec, resume on a FRESH engine — prefix + continuation equals the
+    uninterrupted stream bit for bit (RNG state travels in the
+    snapshot)."""
+    opts = SamplingOptions(temperature=temp, top_k=20 if temp else 0, **OPTS)
+    prompt = [3, 5, 7, 11, 13]
+    src = make_engine(kind, kv_quant)
+    base = drain(src, src.submit(list(prompt), opts))
+
+    victim = make_engine(kind, kv_quant)
+    gid = victim.submit(list(prompt), opts)
+    run_partway(victim, gid, 9)
+    snap = victim.export_session(gid)
+    assert snap is not None
+    assert victim.metrics.get_counter("sessions_exported") == 1
+    assert 0 < len(snap["generated"]) < len(base)
+
+    frames = encode_session("mig", snap, page_size=8, att="mig#1")
+    snap2, meta = decode_session(frames)
+    assert meta["op"] == "migrate.ckpt" and meta["att"] == "mig#1"
+
+    dst = make_engine(kind, kv_quant)
+    gid2 = dst.resume_session(snap2)
+    assert gid2 is not None
+    assert dst.metrics.get_counter("sessions_resumed") == 1
+    rest = drain(dst, gid2)
+    assert snap["generated"] + rest == base
+    # Resume emitted nothing by itself; the tail restarted exactly after
+    # the snapshot — no token lost, none duplicated.
+    assert dst.sessions.get(gid2).resumes == 1
+
+
+def test_export_unknown_or_finished_returns_none():
+    e = make_engine()
+    assert e.export_session("nope") is None
+    opts = SamplingOptions(max_new_tokens=4)
+    gid = e.submit([1, 2, 3], opts)
+    drain(e, gid)
+    assert e.export_session(gid) is None  # FINISHED: nothing to migrate
+
+
+def test_resume_rejects_bad_snapshots():
+    e = make_engine("paged", "int8")
+    gid = e.submit([2, 4, 6, 8], SamplingOptions(temperature=0.5, **OPTS))
+    run_partway(e, gid, 6)
+    snap = e.export_session(gid)
+    assert snap is not None
+
+    # Quantized target without the scale planes: reject before import.
+    crippled = dict(snap)
+    crippled["planes"] = {
+        k: v for k, v in snap["planes"].items() if k in ("k", "v")
+    }
+    with pytest.raises(ValueError):
+        make_engine("paged", "int8").resume_session(crippled)
+
+    # A snapshot whose budget is already spent has nothing to resume.
+    done = dict(snap)
+    done["options"] = dict(
+        snap["options"], max_new_tokens=len(snap["generated"])
+    )
+    with pytest.raises(ValueError):
+        make_engine("paged", "int8").resume_session(done)
+
+    # ... same when the tail already ends at eos.
+    eos_done = dict(snap)
+    eos_done["options"] = dict(
+        snap["options"], eos_token_id=int(snap["generated"][-1])
+    )
+    with pytest.raises(ValueError):
+        make_engine("paged", "int8").resume_session(eos_done)
+
+    # An empty tail has no decode position to anchor on.
+    empty = dict(snap)
+    empty["generated"] = []
+    with pytest.raises(ValueError):
+        make_engine("paged", "int8").resume_session(empty)
+
+
+def test_resume_returns_none_at_capacity():
+    e = make_engine("paged", "int8")
+    gid = e.submit([2, 4, 6, 8], SamplingOptions(temperature=0.5, **OPTS))
+    run_partway(e, gid, 6)
+    snap = e.export_session(gid)
+
+    crowded = make_engine("paged", "int8", batch=1)
+    crowded.submit([9, 9, 9], SamplingOptions(**OPTS))
+    crowded.step()  # the only slot is now occupied
+    assert crowded.resume_session(snap) is None  # pressure, not an error
+
+
+def test_decode_session_rejects_plain_prefill_frames():
+    import numpy as np
+
+    planes = {"k": np.zeros((2, 4, 2, 16), np.float32),
+              "v": np.zeros((2, 4, 2, 16), np.float32)}
+    frames = encode_kv("x", planes, 4, 7)
+    with pytest.raises(ValueError, match="session"):
+        decode_session(frames)
+
+
+# -- lease fencing ------------------------------------------------------------
+
+
+def test_stale_epoch_register_rejected():
+    d = BlockDirectory(default_ttl=5.0)
+    assert d.register("n", 0, 1, "decode.n", role="decode", epoch=2)
+    assert d.fence("n") == 2
+    # The fenced incarnation (and anything older) can never come back.
+    assert not d.register("n", 0, 1, "decode.n", role="decode", epoch=2)
+    assert not d.register("n", 0, 1, "decode.n", role="decode", epoch=1)
+    assert d.fenced_rejections == 2
+    # A genuine restart re-joins above the floor.
+    assert d.register("n", 0, 1, "decode.n", role="decode", epoch=3)
+    # An older incarnation can also never displace a newer live holder.
+    assert not d.register("n", 0, 1, "decode.n", role="decode", epoch=2)
+    assert d.alive()[0].epoch == 3
+
+
+def test_heartbeat_epoch_fencing():
+    d = BlockDirectory(default_ttl=5.0)
+    d.register("n", 0, 1, "q", epoch=4)
+    assert d.heartbeat("n", epoch=4)
+    assert not d.heartbeat("n", epoch=3)  # zombie renewal refused
+    assert d.stale_heartbeats == 1
+    assert not d.heartbeat("ghost", epoch=1)  # expired/unknown: re-register
+    # Epoch-less heartbeat keeps working for pre-fencing callers.
+    assert d.heartbeat("n")
+
+
+def test_fence_floor_rises_monotonically():
+    d = BlockDirectory(default_ttl=5.0)
+    assert d.fence("cold", epoch=7) == 7  # fence an unknown node: floor set
+    assert not d.register("cold", 0, 1, "q", epoch=7)
+    assert d.register("cold", 0, 1, "q", epoch=8)
+    assert d.fence("cold") == 8
+    assert d.fence("cold", epoch=3) == 8  # floors never move down
+
+
+def test_assign_and_route_skip_expired_leases():
+    d = BlockDirectory(default_ttl=5.0)
+    d.register("live", 0, 1, "q1", ttl=30.0)
+    d.register("dying", 2, 3, "q2", ttl=0.05)
+    time.sleep(0.1)
+    # The dead node's hole is re-advertised; the live range is not.
+    assert d.assign(4, span=2) == (2, 3)
+    assert [n.node_id for n in d.alive()] == ["live"]
+    with pytest.raises(LookupError):
+        d.plan_route(4)  # layer 2 is genuinely uncovered now
+
+
+def test_concurrent_epoch_churn_stress():
+    """30 iterations of register/heartbeat/fence per node, with a gateway
+    thread fencing concurrently: the table must stay consistent (no
+    exceptions, every surviving lease above its fence floor)."""
+    d = BlockDirectory(default_ttl=5.0)
+    errs = []
+
+    def nodelife(k):
+        try:
+            for it in range(30):
+                ep = it + 1
+                if d.register(f"c{k}", 0, 3, f"decode.c{k}",
+                              role="decode", epoch=ep):
+                    d.heartbeat(f"c{k}", load=it, epoch=ep)
+                if it % 5 == k:  # this incarnation dies; gateway fences it
+                    d.fence(f"c{k}", epoch=ep)
+                    # A zombie replaying the fenced epoch must be refused.
+                    assert not d.register(f"c{k}", 0, 3, f"decode.c{k}",
+                                          role="decode", epoch=ep)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def gateway():
+        try:
+            for it in range(30):
+                d.fence(f"c{it % 4}")
+                d.alive()
+                time.sleep(0.001)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=nodelife, args=(k,)) for k in range(4)]
+    threads.append(threading.Thread(target=gateway))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    floors = d._fenced
+    for n in d.alive():
+        assert n.epoch > floors.get(n.node_id, -1)
+    assert d.fenced_rejections >= 1  # churn provoked real fencing
+
+
+# -- chaos crash --------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.chaos
+def test_chaos_crash_severs_and_refuses_reconnects():
+    from distributed_llm_inference_tpu.distributed.chaos import (
+        ChaosProxy,
+        FaultPlan,
+    )
+    from distributed_llm_inference_tpu.distributed.relay import RelayClient
+
+    plan = FaultPlan.from_specs(["crash:doomed:put"], seed=3)
+    with RelayServer() as relay:
+        with ChaosProxy("127.0.0.1", relay.port, plan=plan) as proxy:
+            c1 = RelayClient("127.0.0.1", proxy.port)
+            # PUT is fire-and-forget (no-resend contract), so the crash
+            # fires in the proxy's pipe thread after the send returns:
+            # wait for the whole-node death to take effect.
+            try:
+                c1.put("doomed", b"payload")
+            except (ConnectionError, OSError):
+                pass
+            deadline = time.monotonic() + 10
+            while not proxy.crashed and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert proxy.crashed
+            assert plan.injected and plan.injected[0][0] == "crash"
+            # Whole-node death: anything that needs a response through the
+            # proxy fails — existing AND fresh connections (heartbeats
+            # stop with the data path).
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                c1.get("doomed", timeout=0.5)
+            c2 = RelayClient("127.0.0.1", proxy.port)
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                c2.get("other", timeout=0.5)
+            # The relay itself is untouched: direct clients still work.
+            c3 = RelayClient("127.0.0.1", relay.port)
+            c3.put("side", b"ok")
+            assert c3.get("side", timeout=5.0) == b"ok"
+            c3.close()
+            # A revived zombie can reconnect (its stale epoch is then the
+            # directory's problem — see the fencing tests).
+            proxy.revive()
+            c4 = RelayClient("127.0.0.1", proxy.port)
+            c4.put("side2", b"back")
+            assert c4.get("side2", timeout=5.0) == b"back"
+            c4.close()
+
+
+# -- recovery gateway e2e -----------------------------------------------------
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    yield loop
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def _fleet_stream(backend, loop, prompt, opts, timeout=60.0):
+    h = backend.submit(prompt, opts, deadline=time.monotonic() + timeout)
+
+    async def _drain():
+        toks, seqs, resumed = [], [], 0
+        while True:
+            ev = await asyncio.wait_for(h.queue.get(), timeout=timeout)
+            resumed = max(resumed, ev.resumed)
+            if ev.token >= 0:
+                toks.append(ev.token)
+                seqs.append(ev.seq)
+            if ev.finished:
+                return toks, seqs, ev.finish_reason, resumed
+
+    return asyncio.run_coroutine_threadsafe(_drain(), loop).result(
+        timeout=timeout + 30
+    )
+
+
+RECOVERY_DCFG = DisaggConfig(
+    lease_ttl_s=1.0, checkpoint_interval_ticks=2, resume_max_attempts=2,
+)
+
+
+@needs_native
+def test_fleet_stream_uninterrupted(loop):
+    """No faults: the fleet path streams byte-exact vs a local engine,
+    stamps sequential seqs, and reports zero resumes."""
+    prompt = [3, 5, 7, 11, 13]
+    opts = SamplingOptions(temperature=0.8, top_k=20, **OPTS)
+    e = make_engine()
+    base = drain(e, e.submit(list(prompt), opts))
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=5.0):
+            node = DecodeNode(relay.port, make_engine(), node_id="n1",
+                              disagg_cfg=RECOVERY_DCFG, epoch=1)
+            backend = FleetBackend(relay.port, disagg_cfg=RECOVERY_DCFG)
+            backend.start(loop)
+            try:
+                toks, seqs, reason, resumed = _fleet_stream(
+                    backend, loop, prompt, opts
+                )
+                assert toks == base and reason == "length"
+                assert seqs == list(range(len(toks)))
+                assert resumed == 0
+                assert backend.metrics.get_counter(
+                    "node_deaths_detected") == 0
+                assert node.engine.metrics.get_counter(
+                    "checkpoints_shipped") >= 1
+            finally:
+                backend.stop()
+                node.stop()
+
+
+@needs_native
+def test_fleet_no_nodes_is_terminal_not_a_hang(loop):
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=5.0):
+            backend = FleetBackend(relay.port, disagg_cfg=RECOVERY_DCFG,
+                                   pool_wait_s=0.3)
+            backend.start(loop)
+            try:
+                assert not backend.probe()
+                toks, seqs, reason, resumed = _fleet_stream(
+                    backend, loop, [1, 2, 3],
+                    SamplingOptions(max_new_tokens=4), timeout=20.0,
+                )
+                assert toks == [] and reason.startswith("error")
+            finally:
+                backend.stop()
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind,kv_quant,temp", [
+    ("paged", None, 0.8),
+    ("paged", "int8", 0.0),
+    ("dense", None, 0.0),
+    ("dense", "int8", 0.8),
+])
+def test_crash_mid_decode_recovers_byte_exact(loop, kind, kv_quant, temp):
+    """The acceptance scenario: a decode node whole-node-crashes
+    mid-stream (data and heartbeats die together); the gateway detects
+    the death, fences the node, resumes on the survivor, and the
+    client-visible stream is BYTE-EXACT vs an uninterrupted run — zero
+    tokens lost, zero duplicated (greedy and sampled, dense and paged,
+    f32 and int8 KV)."""
+    from distributed_llm_inference_tpu.distributed.chaos import (
+        ChaosProxy,
+        FaultPlan,
+    )
+
+    prompt = [3, 5, 7, 11, 13]
+    opts = SamplingOptions(temperature=temp, top_k=20 if temp else 0, **OPTS)
+    e = make_engine(kind, kv_quant)
+    base = drain(e, e.submit(list(prompt), opts))
+
+    plan = FaultPlan.from_specs(["crash:fleet.tok.*:put:after=6"], seed=7)
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=5.0):
+            with ChaosProxy("127.0.0.1", relay.port, plan=plan) as proxy:
+                # n1 (first in directory order, so picked at submit) does
+                # ALL its relay traffic through the chaos proxy: after 6
+                # reply frames the proxy crashes — token stream AND
+                # heartbeats stop, the lease expires, n2 takes over.
+                n1 = DecodeNode(proxy.port, make_engine(kind, kv_quant),
+                                node_id="n1", disagg_cfg=RECOVERY_DCFG,
+                                epoch=1)
+                n2 = DecodeNode(relay.port, make_engine(kind, kv_quant),
+                                node_id="n2", disagg_cfg=RECOVERY_DCFG,
+                                epoch=1)
+                backend = FleetBackend(relay.port, disagg_cfg=RECOVERY_DCFG)
+                backend.start(loop)
+                try:
+                    toks, seqs, reason, resumed = _fleet_stream(
+                        backend, loop, prompt, opts
+                    )
+                    assert plan.injected, "crash fault never fired"
+                    assert toks == base and reason == "length"
+                    assert seqs == list(range(len(toks)))  # no dup, no gap
+                    assert resumed == 1
+                    m = backend.metrics
+                    assert m.get_counter("node_deaths_detected") == 1
+                    assert m.get_counter("resume_attempts") == 1
+                    assert m.get_counter("resume_failures") == 0
+                finally:
+                    backend.stop()
+                    n2.stop()
+                    n1.stop()
+
+
+# -- wire extensions ----------------------------------------------------------
+
+
+def test_sse_event_stamps_seq():
+    out = sse_event({"x": 1}, seq=4)
+    assert json.loads(out[len(b"data: "):].decode())["seq"] == 4
+    assert b"seq" not in sse_event({"x": 1})  # unstamped stays untouched
+
+
+def test_usage_carries_resume_count():
+    ch = completion_chunk("id", 0, "m", None, "length",
+                          usage={"resumed": 2, "completion_tokens": 9})
+    assert ch["usage"]["resumed"] == 2
+    assert "usage" not in completion_chunk("id", 0, "m", 5, None)
+    doc = completion_response("id", 0, "m", [1, 2], "length", 3, resumed=1)
+    assert doc["usage"]["resumed"] == 1
+    plain = completion_response("id", 0, "m", [1, 2], "length", 3)
+    assert "resumed" not in plain["usage"]  # OpenAI shape stays exact

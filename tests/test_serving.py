@@ -120,6 +120,14 @@ def test_sse_stream_yields_tokens_and_done():
         c["choices"][0]["finish_reason"] is None for c in token_chunks
     )
     assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    # Every token chunk carries its sequence index (exactly-once
+    # bookkeeping for recoverable streams); the terminal chunk carries
+    # the usage block with the resume count (0: nothing was re-homed).
+    assert [c["seq"] for c in token_chunks] == [0, 1, 2]
+    assert chunks[-1]["usage"] == {
+        "prompt_tokens": 2, "completion_tokens": 3, "total_tokens": 5,
+        "resumed": 0,
+    }
 
 
 def test_queue_full_gets_429_with_retry_after():

@@ -25,6 +25,14 @@
 # suites in their historical order first (the full suite outlasts the
 # cap; an uncapped `pytest tests/` covers everything).
 #
+# The crash-recovery contract tests (tests/test_migration.py, marked
+# 'disagg': export/resume byte-exactness across cache kinds and KV
+# quant, lease-fence epoch rules, the chaos 'crash' whole-node-death
+# drill, and the FleetBackend crash-mid-decode resume e2e) are
+# deliberately NOT marked 'slow': they are the correctness gate for
+# zero-token-loss session migration and ride the disagg block at the
+# end of the schedule.
+#
 # The admission-overlap contract tests (tests/test_engine.py, the
 # "overlapped (stall-free) admission" section: byte-exact parity with
 # overlap_admission on/off, cancel/deadline-during-inflight-prefill,
