@@ -59,6 +59,31 @@ def _table_write_batch(table, rows, slots, pages):
     return table.at[rows, slots].set(pages, mode="drop")
 
 
+@jax.jit
+def _page_copy(pool, dst, src):
+    """Device-side page duplicate (copy-on-write split): pool[:, dst] ←
+    pool[:, src]. ``dst``/``src`` are TRACED — one executable per pool
+    shape/dtype, not per page pair."""
+    tile = jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)
+    return jax.lax.dynamic_update_slice_in_dim(pool, tile, dst, axis=1)
+
+
+@jax.jit
+def _page_read(pool, page):
+    """One page's tile ``[L, heads, PS(, D)]`` (traced index — cached
+    executable per pool shape; the host copy happens at np.asarray time)."""
+    return jax.lax.dynamic_slice_in_dim(pool, page, 1, axis=1)[:, 0]
+
+
+@jax.jit
+def _page_write(pool, tile, page):
+    """Install a host-provided page tile at ``pool[:, page]`` (traced
+    index; spill-tier reload path)."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        pool, tile[:, None], page, axis=1
+    )
+
+
 def _page_chunks(a, cap, slots, ps):
     """Chunk contiguous 1-row ring KV ``[L, 1, S, ...]`` into per-page
     tiles ``[L, slots, heads, PS(, D)]`` (shared by the bf16 and int8 pool
@@ -91,6 +116,9 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     BATCH_AXES = {"page_table": 0, "lengths": 0}
     LAYER_FIELDS = ("k_pages", "v_pages")
     SHARED_FIELDS = ("k_pages", "v_pages")
+    # Stored-form plane name -> pool field (export/spill/reload share this
+    # map so the host-facing naming cannot drift between them).
+    PLANE_FIELDS = {"k": "k_pages", "v": "v_pages"}
 
     @staticmethod
     def create(
@@ -405,7 +433,7 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             **updated,
         )
 
-    def ingest_row(self, ks, vs, n_valid):
+    def ingest_row(self, ks, vs, n_valid, first_slot=0):
         """Install ring-prefill KV into the page pool (cf.
         ``DenseKVCache.ingest_row``; 1-row ``select_row`` view — the pool
         is SHARED, so the pages land in place and ``merge_row`` writes the
@@ -413,10 +441,17 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         chunked into page-size pieces and scattered to this row's table
         slots. Slots past the assigned run hold the null page; their junk
         writes are never read (validity derives from ``lengths``), and
-        duplicate null-page indices are harmless for the same reason."""
-        return self._ingest_planes({"k_pages": ks, "v_pages": vs}, n_valid)
+        duplicate null-page indices are harmless for the same reason.
 
-    def _ingest_planes(self, planes, n_valid):
+        ``first_slot`` > 0 additionally diverts the HEAD of the run: slots
+        below it map SHARED prefix pages whose content is already resident
+        (disaggregated admission with a local prefix hit) and must not be
+        overwritten with the shipped copy."""
+        return self._ingest_planes(
+            {"k_pages": ks, "v_pages": vs}, n_valid, first_slot
+        )
+
+    def _ingest_planes(self, planes, n_valid, first_slot=0):
         """Shared ring-ingest write pattern (bf16 values and int8+scale
         planes alike): chunk each contiguous plane into page tiles and
         scatter to this row's table slots, then set lengths. Batch-1 views
@@ -429,13 +464,16 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             )
         ps = self.page_size
         slots = self.page_table.shape[1]
-        # Scatter ONLY the first ceil(n_valid/page_size) slots — the run this
-        # ingest actually owns. Slots past it are diverted to the null page
-        # (page 0): today they hold the null page anyway, but a future caller
-        # with shared prefix pages still mapped there would otherwise get
-        # them silently overwritten with ring junk.
+        # Scatter ONLY slots [first_slot, ceil(n_valid/page_size)) — the run
+        # this ingest actually owns. Slots outside it are diverted to the
+        # null page (page 0): past the run they hold the null page anyway,
+        # and below ``first_slot`` they map shared prefix pages that must
+        # not be overwritten with this ingest's copy of the same content.
         n_owned = (jnp.asarray(n_valid, jnp.int32) + ps - 1) // ps
-        owned = jnp.arange(slots, dtype=jnp.int32) < n_owned
+        arange = jnp.arange(slots, dtype=jnp.int32)
+        owned = (arange >= jnp.asarray(first_slot, jnp.int32)) & (
+            arange < n_owned
+        )
         pages = jnp.where(owned, self.page_table[0], 0)
         updates = {
             name: getattr(self, name).at[:, pages].set(
@@ -451,6 +489,58 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             ),
             **updates,
         )
+
+    def copy_page(self, dst: int, src: int) -> "PagedKVCache":
+        """Duplicate page ``src`` into ``dst`` across every pool plane —
+        the device half of a copy-on-write split. Pure page-pool op: the
+        table/lengths are untouched (the scheduler remaps the splitting
+        session's slot to ``dst`` itself)."""
+        dst = jnp.int32(dst)
+        src = jnp.int32(src)
+        return self.replace(**{
+            f: _page_copy(getattr(self, f), dst, src)
+            for f in self.PLANE_FIELDS.values()
+        })
+
+    def read_page(self, page: int) -> Dict[str, np.ndarray]:
+        """Host copies of one page's tiles in STORED form, keyed by plane
+        name (``{"k": [L, Hkv, PS, D], "v": …}``, plus ``ks``/``vs``
+        ``[L, Hkv, PS]`` scales on the quantized pool). ``np.asarray``
+        blocks until pending device writes to the page have completed, so
+        the spill tier always captures settled content."""
+        p = jnp.int32(page)
+        return {
+            name: np.asarray(_page_read(getattr(self, f), p))
+            for name, f in self.PLANE_FIELDS.items()
+        }
+
+    def write_page(self, page: int, tiles: Dict[str, np.ndarray]) -> "PagedKVCache":
+        """Install :meth:`read_page`-form tiles at ``page`` (spill-tier
+        reload). Validates plane names, shapes, and dtypes and raises
+        ``ValueError`` on any mismatch — a corrupted arena entry must be
+        rejected here, before it can poison the pool."""
+        want = set(self.PLANE_FIELDS)
+        if set(tiles) != want:
+            raise ValueError(
+                f"page tiles {sorted(tiles)} do not match this pool "
+                f"(want {sorted(want)})"
+            )
+        out = {}
+        for name, f in self.PLANE_FIELDS.items():
+            pool = getattr(self, f)
+            tile = np.asarray(tiles[name])
+            expect = pool.shape[:1] + pool.shape[2:]
+            if tuple(tile.shape) != tuple(expect):
+                raise ValueError(
+                    f"page tile {name!r} shape {tile.shape} != {tuple(expect)}"
+                )
+            if tile.dtype.name != pool.dtype.name:
+                raise ValueError(
+                    f"page tile {name!r} dtype {tile.dtype.name} != "
+                    f"{pool.dtype.name}"
+                )
+            out[f] = _page_write(pool, jnp.asarray(tile), jnp.int32(page))
+        return self.replace(**out)
 
     def assign_pages(self, row: int, pages, start_slot: int = 0) -> "PagedKVCache":
         """Host-side helper: install allocator-chosen page ids for a row.
@@ -518,6 +608,12 @@ class PageAllocator:
         self._registry: Dict[bytes, int] = {}      # chain key -> page
         self._page_key: Dict[int, bytes] = {}      # page -> chain key
         self._lru: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+        # Eviction hook (prefixstore spill tier): called with (page, key)
+        # BEFORE the page returns to the free list, while its content is
+        # still valid — the engine snapshots the tiles to its host arena.
+        # Runs under the engine's scheduler lock like every allocator call;
+        # a hook failure must not wedge eviction (callers catch their own).
+        self.on_evict = None
 
     @property
     def free_count(self) -> int:
@@ -539,6 +635,8 @@ class PageAllocator:
         key = self._page_key.pop(page)
         del self._registry[key]
         del self._refs[page]
+        if self.on_evict is not None:
+            self.on_evict(page, key)
         self._free.append(page)
         self._free_set.add(page)
 
@@ -568,6 +666,29 @@ class PageAllocator:
             self._lru.pop(page, None)  # referenced: not evictable
             pages.append(page)
         return pages
+
+    def lookup_one(self, key: bytes) -> Optional[int]:
+        """One registered page by key, refcounted like :meth:`lookup`
+        (caller owns a reference), or ``None`` when the key is not cached —
+        the spill-reload walk checks the device registry page-by-page."""
+        page = self._registry.get(key)
+        if page is None:
+            return None
+        self._refs[page] += 1
+        self._lru.pop(page, None)
+        return page
+
+    def peek(self, key: bytes) -> Optional[int]:
+        """Registered page for ``key`` WITHOUT taking a reference — for
+        match-length probes (routing) that must not pin pages."""
+        return self._registry.get(key)
+
+    def registered_keys(self, limit: int = 0) -> List[bytes]:
+        """Registered chain keys, oldest first (dict insertion order);
+        ``limit`` > 0 keeps only the NEWEST that many — the bounded set a
+        node advertises to the directory."""
+        keys = list(self._registry)
+        return keys[-limit:] if limit > 0 else keys
 
     def register(self, page: int, key: bytes) -> None:
         """Content-address ``page`` (a full prompt-prefix page) under ``key``.
@@ -641,6 +762,9 @@ class QuantizedPagedKVCache(PagedKVCache):
     BATCH_AXES = {"page_table": 0, "lengths": 0}
     LAYER_FIELDS = ("k_pages", "v_pages", "ks_pages", "vs_pages")
     SHARED_FIELDS = ("k_pages", "v_pages", "ks_pages", "vs_pages")
+    PLANE_FIELDS = {
+        "k": "k_pages", "v": "v_pages", "ks": "ks_pages", "vs": "vs_pages",
+    }
 
     @staticmethod
     def create(
@@ -687,16 +811,16 @@ class QuantizedPagedKVCache(PagedKVCache):
             ),
         )
 
-    def ingest_row(self, ks, vs, n_valid):
+    def ingest_row(self, ks, vs, n_valid, first_slot=0):
         """Ring-prefill ingest, quantized pool form: per-(token, head)
         int8 + scale planes (cf. ``QuantizedDenseKVCache.ingest_row``)."""
         from .dense import _quantize_kv
 
         k_q, k_s = _quantize_kv(ks)  # [L, 1, S, H, D] / [L, 1, S, H]
         v_q, v_s = _quantize_kv(vs)
-        return self.ingest_planes_row(k_q, v_q, k_s, v_s, n_valid)
+        return self.ingest_planes_row(k_q, v_q, k_s, v_s, n_valid, first_slot)
 
-    def ingest_planes_row(self, k_q, v_q, k_s, v_s, n_valid):
+    def ingest_planes_row(self, k_q, v_q, k_s, v_s, n_valid, first_slot=0):
         """Install ALREADY-quantized planes (int8 values ``[L, 1, S, H, D]``
         + f32 scales ``[L, 1, S, H]``) without requantizing — disaggregated
         decode imports the prefill pool's STORED planes bit-exact (cf.
@@ -705,6 +829,7 @@ class QuantizedPagedKVCache(PagedKVCache):
             {"k_pages": k_q, "v_pages": v_q,
              "ks_pages": k_s, "vs_pages": v_s},
             n_valid,
+            first_slot,
         )
 
     def _scatter_q(self, layer_k, layer_v, layer_ks, layer_vs, k_rot, v_new,

@@ -322,6 +322,30 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PrefixConfig:
+    """Fleet-wide prefix/KV reuse policy (``prefixstore/``): copy-on-write
+    shared prefix pages inside one engine, a bounded host-DRAM spill tier
+    for evicted prefix pages, and prefix-aware request routing across the
+    fleet. Requires ``CacheConfig.prefix_caching`` (paged cache) for the
+    engine-level layers; routing knobs apply to the gateway backends."""
+
+    # Live copy-on-write sharing: sessions register their full prompt pages
+    # at ADMISSION (not just at release), so concurrent sessions sharing a
+    # prefix attach to the same device pages; a session whose write offset
+    # lands inside a shared page splits it copy-on-write first.
+    prefix_share: bool = True
+    # Host-DRAM spill arena byte budget for evicted prefix pages (stored
+    # form: int8+scales or value-dtype bits). 0 disables spilling.
+    spill_bytes_max: int = 0
+    # Gateway backends route a request to the node advertising the longest
+    # matching prefix head (falling back to least-loaded).
+    route_by_prefix: bool = True
+    # Minimum matched prefix TOKENS before prefix-aware routing overrides
+    # the least-loaded choice (sub-page matches are never worth a detour).
+    min_shared_tokens: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class DisaggConfig:
     """Disaggregated prefill/decode policy (``disagg/``, ``serving``'s
     ``DisaggBackend``): how the gateway ships prompts to the prefill pool

@@ -390,6 +390,17 @@ class DecodeNode:
                         )
                         self._stop.set()
                         return
+                if self.engine.ccfg.prefix_caching:
+                    # Prefix-aware routing (prefixstore/): piggyback this
+                    # node's cached-prefix key set on the heartbeat cadence
+                    # so gateways can route a prompt to the node already
+                    # holding its prefix. Whole-set refresh: eviction needs
+                    # no tombstones, staleness costs only a suboptimal
+                    # route (the engine recomputes on a miss).
+                    self._directory.advertise_prefixes(
+                        self.node_id, self.engine.ccfg.page_size,
+                        self.engine.advertised_prefix_heads(),
+                    )
             except Exception:
                 continue  # transient control-plane failure: keep serving
 

@@ -72,6 +72,12 @@ class BlockDirectory:
         # service process; scraping happens via snapshots, not Metrics).
         self.fenced_rejections = 0
         self.stale_heartbeats = 0
+        # Prefix-cache advertisements (prefixstore/): node_id -> (page_size,
+        # hex chain-key set) — which prompt-prefix pages each node can serve
+        # a cache hit from. Refreshed whole-set each heartbeat cycle and
+        # dropped with the lease: stale entries only cost a suboptimal
+        # route, never a wrong answer (the engine recomputes on a miss).
+        self._prefix: Dict[str, Tuple[int, frozenset]] = {}
 
     def register(
         self, node_id: str, first_layer: int, last_layer: int, queue: str,
@@ -145,6 +151,7 @@ class BlockDirectory:
     def remove(self, node_id: str) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
+            self._prefix.pop(node_id, None)
 
     def fence(self, node_id: str, epoch: Optional[int] = None) -> int:
         """Evict ``node_id`` and bar its current incarnation from ever
@@ -157,6 +164,7 @@ class BlockDirectory:
         that now lives elsewhere."""
         with self._lock:
             info = self._nodes.pop(node_id, None)
+            self._prefix.pop(node_id, None)
             floor = self._fenced.get(node_id, -1)
             if epoch is not None:
                 floor = max(floor, int(epoch))
@@ -171,6 +179,7 @@ class BlockDirectory:
         now = time.monotonic()
         for nid in [n for n, i in self._nodes.items() if i.lease_expiry < now]:
             del self._nodes[nid]
+            self._prefix.pop(nid, None)
 
     def alive(self) -> List[NodeInfo]:
         with self._lock:
@@ -237,6 +246,59 @@ class BlockDirectory:
                     pending=True,
                 )
         return first, last
+
+    # Per-node advertisement cap: a decode node's working set of REGISTERED
+    # prefix pages, not its whole history — bounds directory memory at
+    # ~forty bytes per key without changing match results for live prefixes
+    # (the engine advertises its newest keys, matching its LRU survivors).
+    MAX_PREFIX_HEADS = 4096
+
+    def advertise_prefixes(self, node_id: str, page_size: int,
+                           heads: List[str]) -> bool:
+        """Replace ``node_id``'s advertised prefix-key set (hex chain keys
+        of the prefix pages it can serve a cache hit from — device registry
+        plus host spill arena). Whole-set replacement per heartbeat keeps
+        the directory trivially consistent with the node's LRU: no
+        tombstone protocol for evicted pages. Returns ``False`` (dropped)
+        when the node holds no live lease — an advertisement must never
+        outlive membership."""
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        with self._lock:
+            self._expire_locked()
+            info = self._nodes.get(node_id)
+            if info is None or info.pending:
+                self._prefix.pop(node_id, None)
+                return False
+            self._prefix[node_id] = (
+                int(page_size),
+                frozenset(heads[-self.MAX_PREFIX_HEADS:]),
+            )
+            return True
+
+    def match_prefix(self, prompt: List[int]) -> Tuple[Optional[str], int]:
+        """The decode node holding the LONGEST advertised prefix of
+        ``prompt`` (in tokens, page-granular), lower load breaking ties —
+        the prefix-aware routing primitive. ``(None, 0)`` when nothing
+        matches; pending/prefill-only nodes never match (there is no
+        decode engine to hit)."""
+        from ..prefixstore.index import match_tokens
+
+        best: Optional[str] = None
+        best_tokens = 0
+        best_load = 0
+        with self._lock:
+            self._expire_locked()
+            for nid, (ps, heads) in self._prefix.items():
+                info = self._nodes.get(nid)
+                if info is None or info.pending or info.role == "prefill":
+                    continue
+                got = match_tokens(prompt, ps, heads)
+                if got > best_tokens or (
+                    got == best_tokens and got > 0 and info.load < best_load
+                ):
+                    best, best_tokens, best_load = nid, got, info.load
+        return best, best_tokens
 
     def plan_route(self, num_layers: int) -> List[NodeInfo]:
         """Greedy chain cover of layers ``[0, num_layers)``: at each position
@@ -329,6 +391,15 @@ class DirectoryService:
                      "last_layer": n.last_layer, "queue": n.queue}
                     for n in route
                 ]}
+            if op == "prefix.advertise":
+                ok = d.advertise_prefixes(
+                    req["node_id"], req["page_size"],
+                    list(req.get("heads", [])),
+                )
+                return {"ok": ok}
+            if op == "prefix.match":
+                node_id, tokens = d.match_prefix(list(req["prompt"]))
+                return {"ok": True, "node_id": node_id, "tokens": tokens}
             if op == "alive":
                 return {"ok": True, "nodes": [
                     {"node_id": n.node_id, "first_layer": n.first_layer,
@@ -425,6 +496,25 @@ class DirectoryClient:
 
     def alive(self) -> List[dict]:
         return self._call({"op": "alive"})["nodes"]
+
+    def advertise_prefixes(self, node_id: str, page_size: int,
+                           heads: List[str]) -> bool:
+        """Refresh this node's advertised prefix-key set (see
+        :meth:`BlockDirectory.advertise_prefixes`); rides the heartbeat
+        cadence. ``False`` = no live lease, the set was dropped."""
+        return self._call({"op": "prefix.advertise", "node_id": node_id,
+                           "page_size": page_size, "heads": heads})["ok"]
+
+    def match_prefix(self, prompt: List[int],
+                     timeout: float = 5.0) -> Tuple[Optional[str], int]:
+        """Which decode node holds the longest cached prefix of ``prompt``
+        (see :meth:`BlockDirectory.match_prefix`): ``(node_id | None,
+        matched_tokens)``."""
+        r = self._call(
+            {"op": "prefix.match", "prompt": list(map(int, prompt))},
+            timeout=timeout,
+        )
+        return r.get("node_id"), int(r.get("tokens", 0))
 
     def close(self) -> None:
         self._client.close()

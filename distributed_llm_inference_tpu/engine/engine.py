@@ -42,7 +42,7 @@ from ..cache.sink import QuantizedSinkKVCache, SinkKVCache
 # streams, fixed memory): scheduler paths that special-case the sink ring
 # must cover both the bf16 and the int8/kernel variants.
 _SINK_KINDS = (SinkKVCache, QuantizedSinkKVCache)
-from ..config import CacheConfig, EngineConfig, ModelConfig
+from ..config import CacheConfig, EngineConfig, ModelConfig, PrefixConfig
 from ..models import llama
 from ..utils.metrics import Metrics
 from ..utils.tracing import SpanRecorder, span
@@ -68,6 +68,7 @@ class InferenceEngine:
         attention_fn=None,
         mesh_cfg=None,
         draft=None,
+        prefix_cfg=None,
     ):
         """``mesh_cfg`` (a :class:`MeshConfig`) serves one sharded deployment
         of the model: tp/ep shard within a replica, dp shards batch rows, and
@@ -131,6 +132,7 @@ class InferenceEngine:
             raise ValueError(f"unknown quantization {self.ecfg.quantization!r}")
         self.params = params
         self.ccfg = cache_cfg or CacheConfig()
+        self.pcfg = prefix_cfg or PrefixConfig()
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics = Metrics()
         self.spans = SpanRecorder()
@@ -167,6 +169,12 @@ class InferenceEngine:
             )
         )
         self._windows: Tuple[int, ...] = ()
+        # prefixstore state: host spill arena (paged + prefix_caching +
+        # spill budget only) and the cumulative prompt-token reuse ratio
+        # behind the prefix_hit_rate gauge.
+        self._spill = None
+        self._prefix_seen = 0
+        self._prefix_hits = 0
         if cc.kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv_quant {cc.kv_quant!r}")
         if cc.kv_quant is not None and cc.kind not in (
@@ -233,6 +241,16 @@ class InferenceEngine:
                 use_kernel=self._use_pallas,
             )
             self.allocator = PageAllocator(cc.num_pages)
+            if cc.prefix_caching and self.pcfg.spill_bytes_max > 0:
+                # Host-DRAM spill tier (prefixstore/): registered prefix
+                # pages evicted by the refcount-aware LRU snapshot their
+                # stored-form tiles into a bounded host arena instead of
+                # vanishing; a later admission whose chain reaches the key
+                # reloads them with one host->device copy.
+                from ..prefixstore import HostSpillArena
+
+                self._spill = HostSpillArena(self.pcfg.spill_bytes_max)
+                self.allocator.on_evict = self._spill_page
             self._warm_table_write()
         elif cc.kind == "sink":
             if cc.kv_quant == "int8":
@@ -1204,6 +1222,102 @@ class InferenceEngine:
                 del self.sessions[gid]
             return done
 
+    # -- prefix/KV reuse (prefixstore/) ---------------------------------------
+
+    def _note_prefix(self, total: int, reused: int) -> None:
+        """Uniform prefix-reuse accounting: EVERY admission path (local
+        ``_admit``, disaggregated ``admit_prefilled``, spill reloads — they
+        land in the shared-page count) reports through here, so the
+        ``prefix_cached_tokens`` counter and the cumulative token-weighted
+        ``prefix_hit_rate`` gauge cannot drift between paths."""
+        self._prefix_seen += total
+        self._prefix_hits += reused
+        if reused:
+            self.metrics.counter("prefix_cached_tokens", reused)
+        if self._prefix_seen:
+            self.metrics.gauge(
+                "prefix_hit_rate", self._prefix_hits / self._prefix_seen
+            )
+
+    def _spill_page(self, page: int, key: bytes) -> None:
+        """Allocator ``on_evict`` hook: snapshot an evicted registered
+        prefix page's stored-form tiles into the host arena (runs under the
+        scheduler lock, inside ``alloc``, BEFORE the page returns to the
+        free list — content still valid; ``read_page`` blocks until pending
+        device writes settle)."""
+        tiles = self.cache.read_page(page)
+        if self._spill.put(key, tiles):
+            self.metrics.counter("prefix_spilled_pages")
+        self.metrics.gauge("prefix_spill_bytes", float(self._spill.bytes_used))
+
+    def _reload_spilled(self, keys, shared: List[int], cap: int) -> List[int]:
+        """Extend a device-registry prefix match with host-arena reloads:
+        walk ``keys[len(shared):cap]``, re-checking the registry first (a
+        taken entry may have been reloaded by an earlier admission), then
+        reloading arena tiles into a fresh page. A rejected (corrupted)
+        entry degrades to recompute from that point — never wedges
+        admission. Returned pages are referenced like ``lookup``'s."""
+        while len(shared) < cap:
+            key = keys[len(shared)]
+            page = self.allocator.lookup_one(key)
+            if page is None:
+                tiles = self._spill.take(key)
+                if tiles is None:
+                    break
+                t0 = time.perf_counter()
+                try:
+                    [page] = self.allocator.alloc(1)
+                except MemoryError:
+                    self._spill.put(key, tiles)  # park it for a calmer tick
+                    break
+                try:
+                    self.cache = self.cache.write_page(page, tiles)
+                except ValueError:
+                    # Corrupted arena entry: reject BEFORE it can poison
+                    # the pool; recompute covers the rest of the prompt.
+                    self.allocator.free([page])
+                    self.metrics.counter("prefix_reload_errors")
+                    break
+                self.allocator.register(page, key)
+                self.metrics.counter("prefix_spill_reloads")
+                self.metrics.observe(
+                    "prefix_reload_ms", (time.perf_counter() - t0) * 1e3
+                )
+            shared.append(page)
+        self.metrics.gauge("prefix_spill_bytes", float(self._spill.bytes_used))
+        return shared
+
+    def advertised_prefix_heads(self, limit: int = 1024) -> List[str]:
+        """Hex chain keys this node can serve a prefix hit from — device
+        registry plus spill arena — newest-biased and bounded; what the
+        decode node advertises to the block directory each heartbeat."""
+        if self.allocator is None or not self.ccfg.prefix_caching:
+            return []
+        with self._lock:
+            keys = self.allocator.registered_keys(limit)
+            if self._spill is not None:
+                dev = set(keys)
+                keys += [k for k in self._spill.keys() if k not in dev]
+        return [k.hex() for k in keys[-limit:]]
+
+    def prefix_match_tokens(self, prompt) -> int:
+        """Longest locally-cached prefix of ``prompt`` in TOKENS
+        (page-granular), WITHOUT taking page references — the gateway's
+        routing probe for preferring a prefix-holding engine."""
+        if self.allocator is None or not self.ccfg.prefix_caching:
+            return 0
+        ps = self.ccfg.page_size
+        keys = PageAllocator.chain_keys(prompt, ps)
+        matched = 0
+        with self._lock:
+            for key in keys:
+                if self.allocator.peek(key) is None and not (
+                    self._spill is not None and key in self._spill
+                ):
+                    break
+                matched += ps
+        return matched
+
     # -- disaggregated prefill/decode (disagg/) -------------------------------
 
     def prefill_export(self, prompt, options=None):
@@ -1387,27 +1501,63 @@ class InferenceEngine:
             if isinstance(self.cache, PagedKVCache):
                 ps = self.ccfg.page_size
                 need = math.ceil((n + 1) / ps)
-                if need > self.allocator.free_count:
+                shared: List[int] = []
+                if self.ccfg.prefix_caching:
+                    s.prefix_keys = PageAllocator.chain_keys(prompt, ps)
+                    if self.pcfg.prefix_share:
+                        # Attach locally cached prefix pages instead of
+                        # re-installing the shipped copy of the same
+                        # content (bit-exact either way: stored-form
+                        # planes round-trip verbatim across pools). The
+                        # FULL chain is eligible — first_token already
+                        # rode the frame, so no last-token recompute (and
+                        # no CoW) is needed here.
+                        shared = self.allocator.lookup(s.prefix_keys)
+                        if self._spill is not None and len(shared) < len(
+                            s.prefix_keys
+                        ):
+                            shared = self._reload_spilled(
+                                s.prefix_keys, shared, len(s.prefix_keys)
+                            )
+                if need - len(shared) > self.allocator.free_count:
+                    if shared:
+                        self.allocator.free(shared)
                     return None  # pool pressure: same signal as a full batch
-                s.pages = self.allocator.alloc(need)
+                s.pages = shared + self.allocator.alloc(need - len(shared))
+                shared_len = len(shared) * ps
                 try:
                     for i, pg in enumerate(s.pages):
                         self._queue_install(slot, i, pg)
                     self._flush_installs()  # the ingest scatter reads the table
-                    sub = self.cache.select_row(slot)
-                    if quant:
-                        sub = sub.ingest_planes_row(
-                            dev["k"], dev["v"], dev["ks"], dev["vs"], n
-                        )
+                    if shared_len < n:
+                        sub = self.cache.select_row(slot)
+                        if quant:
+                            sub = sub.ingest_planes_row(
+                                dev["k"], dev["v"], dev["ks"], dev["vs"], n,
+                                first_slot=len(shared),
+                            )
+                        else:
+                            sub = sub.ingest_row(
+                                dev["k"], dev["v"], n, first_slot=len(shared)
+                            )
+                        self.cache = self.cache.merge_row(sub, slot)
                     else:
-                        sub = sub.ingest_row(dev["k"], dev["v"], n)
-                    self.cache = self.cache.merge_row(sub, slot)
+                        # Whole prompt served from shared pages: nothing to
+                        # ingest, just set the row's write offset.
+                        self.cache = self.cache.replace(
+                            lengths=self.cache.lengths.at[slot].set(n)
+                        )
+                    if shared:
+                        self.metrics.counter(
+                            "prefix_pages_shared", len(shared)
+                        )
                     if self.ccfg.prefix_caching:
                         # Imported prompt pages seed the prefix cache exactly
-                        # like locally prefilled ones.
-                        s.prefix_keys = PageAllocator.chain_keys(prompt, ps)
+                        # like locally prefilled ones (no-op for the shared
+                        # head — those keys are already registered).
                         for i, key in enumerate(s.prefix_keys):
                             self.allocator.register(s.pages[i], key)
+                        self._note_prefix(n, shared_len)
                 except BaseException:
                     # The session was never published — nothing else frees
                     # these pages if the ingest/prefix path raises.
@@ -1774,22 +1924,47 @@ class InferenceEngine:
             shared_len = 0
             if isinstance(self.cache, PagedKVCache):
                 ps = self.ccfg.page_size
-                need = math.ceil((len(s.prompt) + 1) / ps)
-                shared = []
+                n = len(s.prompt)
+                need = math.ceil((n + 1) / ps)
+                shared: List[int] = []
+                cow = False
                 if self.ccfg.prefix_caching:
-                    # Share cached prompt-prefix pages, capped so the LAST
-                    # prompt token is always computed (its logits seed the
-                    # first sampled token).
                     if s.prefix_keys is None:
                         s.prefix_keys = PageAllocator.chain_keys(s.prompt, ps)
-                    shared = self.allocator.lookup(
-                        s.prefix_keys[: (len(s.prompt) - 1) // ps]
-                    )
-                if need - len(shared) > self.allocator.free_count:
+                    # With CoW sharing every FULL prompt page is eligible:
+                    # a fully-matched final page is split copy-on-write
+                    # below so the last prompt token (whose logits seed the
+                    # first sample) recomputes into a private copy. Without
+                    # it, cap so the last token's page is never shared.
+                    cap = n // ps if self.pcfg.prefix_share else (n - 1) // ps
+                    shared = self.allocator.lookup(s.prefix_keys[:cap])
+                    if self._spill is not None and len(shared) < cap:
+                        shared = self._reload_spilled(s.prefix_keys, shared, cap)
+                    # n > 1: a fully-shared 1-token prompt (page_size 1)
+                    # would leave NOTHING to prefill — no logits to sample
+                    # from — so it drops the match and recomputes instead.
+                    cow = bool(shared) and len(shared) * ps == n and n > 1
+                    if not cow and shared and len(shared) * ps == n:
+                        self.allocator.free([shared.pop()])
+                # CoW takes one extra page for the private copy of the
+                # fully-shared final page.
+                if need - len(shared) + cow > self.allocator.free_count:
                     if shared:
                         self.allocator.free(shared)  # return the refs
                     break  # pool pressure: hold the queue, retry next tick
-                s.pages = shared + self.allocator.alloc(need - len(shared))
+                fresh = self.allocator.alloc(need - len(shared) + cow)
+                s.pages = shared + fresh  # owned: _release frees via s
+                if cow:
+                    # Copy-on-write split: the write offset (skip = n-1)
+                    # lands INSIDE the last shared page, so the first fresh
+                    # page takes its table slot. The device copy is deferred
+                    # to dispatch time (_run_prefill) — a same-tick writer's
+                    # prefill must enqueue first — so the source ref is
+                    # parked on s.cow_src until the copy is enqueued.
+                    k = len(shared) - 1
+                    s.cow_src = s.pages[k]
+                    s.pages[k] = s.pages.pop(k + 1)
+                    self.metrics.counter("prefix_cow_copies")
                 # Queue the prompt's pages; _flush_installs applies them
                 # in ONE pow2-padded scatter dispatch right before the
                 # prefill (chained per-page installs paid one tunnel round
@@ -1797,12 +1972,27 @@ class InferenceEngine:
                 # remote compile per new prompt page count).
                 for i, pg in enumerate(s.pages):
                     self._queue_install(slot, i, pg)
-                shared_len = len(shared) * ps
+                shared_len = n - 1 if cow else len(shared) * ps
                 if shared_len:
                     self.cache = self.cache.replace(
                         lengths=self.cache.lengths.at[slot].set(shared_len)
                     )
-                    self.metrics.counter("prefix_cached_tokens", shared_len)
+                    self.metrics.counter("prefix_pages_shared", len(shared))
+                if self.ccfg.prefix_caching:
+                    self._note_prefix(n, shared_len)
+                    if self.pcfg.prefix_share:
+                        # Register-at-admission: this session's full prompt
+                        # pages become shareable NOW (not at release), so
+                        # concurrent sessions attach to the same device
+                        # pages while the writer is still decoding. Safe:
+                        # owned pages hold refs >= 1 (never evicted) and a
+                        # same-tick sharer always dispatches after the
+                        # writer (groups before singles; singles in
+                        # admission order; a sharer has skip > 0 => single).
+                        for i, key in enumerate(s.prefix_keys):
+                            if i >= len(s.pages):
+                                break
+                            self.allocator.register(s.pages[i], key)
             self.waiting.popleft()
             s.slot = slot
             s.state = SessionState.ACTIVE
@@ -2014,6 +2204,16 @@ class InferenceEngine:
         sequence-sharded over the ring instead (one dispatch for the whole
         prompt; each sp device computes ``bucket/sp`` positions)."""
         self._flush_installs()  # prefill writes through the page table
+        if s.cow_src is not None:
+            # Deferred copy-on-write split: enqueue the device copy of the
+            # fully-shared final page into this session's private page, then
+            # drop the parked source ref. Doing this HERE (not at admission)
+            # puts the copy after any same-tick writer's prefill dispatch,
+            # so the source page's content is settled in device order.
+            ps = self.ccfg.page_size
+            self.cache = self.cache.copy_page(s.pages[skip // ps], s.cow_src)
+            self.allocator.free([s.cow_src])
+            s.cow_src = None
         chunk_cap = self._max_chunk()
         prompt = np.asarray(s.prompt, np.int32)
         sp = SamplingParams.create(
@@ -2933,6 +3133,11 @@ class InferenceEngine:
             if self.draft is not None:
                 self._spec_carry_ok[s.slot] = False
             s.slot = None
+        if isinstance(self.cache, PagedKVCache) and s.cow_src is not None:
+            # Parked copy-on-write source ref (normally dropped when
+            # _run_prefill enqueues the copy) — leak-proof the teardown.
+            self.allocator.free([s.cow_src])
+            s.cow_src = None
         if isinstance(self.cache, PagedKVCache) and s.pages:
             if self.ccfg.prefix_caching:
                 # Content-address the pages fully covered by PROMPT tokens so

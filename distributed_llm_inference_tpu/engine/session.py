@@ -52,6 +52,11 @@ class Session:
     # Memoized prompt-prefix chain keys (prefix caching; computed once even
     # when pool pressure re-runs admission over many ticks).
     prefix_keys: Optional[List[bytes]] = None
+    # Copy-on-write source page: set at admission when the prompt fully
+    # matched a cached chain and the final shared page must be split. The
+    # device copy (and this ref's release) happens at prefill-dispatch time
+    # — after any same-tick writer's prefill is enqueued — in _run_prefill.
+    cow_src: Optional[int] = None
     # True while an overlapped-admission prefill is in flight on device
     # (dispatched, first token not yet fetched — engine._inflight_admits).
     # Cancels/deadlines that land in this window drop the fetched result;

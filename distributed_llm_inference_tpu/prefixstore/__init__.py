@@ -1,0 +1,24 @@
+"""Fleet-wide prefix/KV reuse (PagedAttention §4 copy-on-write sharing +
+"Move the Query, Not the Cache" prefix-aware routing).
+
+Three cooperating layers, built around the paged cache's hash-chain keys:
+
+* **Engine-level CoW sharing** — lives in ``engine/engine.py`` +
+  ``cache/paged.py``: sessions register their full prompt pages at
+  admission, concurrent sessions attach to the same device pages
+  read-only, and a session whose write offset lands inside a shared page
+  splits it copy-on-write first.
+* **Host-DRAM spill tier** — :class:`.spill.HostSpillArena`: evicted
+  prefix pages spill to a bounded host arena in stored form and reload
+  through the page pool on a future hit (host→device copy instead of
+  recompute).
+* **Prefix-aware routing** — :mod:`.index` hash-chain helpers shared by
+  the block directory (``prefix.advertise`` / ``prefix.match`` ops) and
+  the gateway backends, which route a request to the decode node holding
+  the longest matching prefix.
+"""
+
+from .index import chain_keys_hex, match_tokens
+from .spill import HostSpillArena
+
+__all__ = ["HostSpillArena", "chain_keys_hex", "match_tokens"]

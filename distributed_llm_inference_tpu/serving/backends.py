@@ -33,7 +33,7 @@ import time
 import uuid
 from typing import Dict, List, Optional, Sequence
 
-from ..config import DisaggConfig
+from ..config import DisaggConfig, PrefixConfig
 from ..engine.sampling import SamplingOptions
 from ..utils.metrics import Metrics
 
@@ -243,10 +243,12 @@ class DisaggBackend(EngineBackend):
         relay_host: str = "127.0.0.1",
         disagg_cfg: Optional[DisaggConfig] = None,
         idle_sleep_s: float = 0.002,
+        prefix_cfg: Optional[PrefixConfig] = None,
     ):
         super().__init__(engine, idle_sleep_s=idle_sleep_s)
         self.relay_host, self.relay_port = relay_host, relay_port
         self.dcfg = disagg_cfg or DisaggConfig()
+        self.pcfg = prefix_cfg or PrefixConfig()
         self._tlock = threading.Lock()
         self._transfers: Dict[str, threading.Thread] = {}
 
@@ -283,6 +285,21 @@ class DisaggBackend(EngineBackend):
         return self.engine.queue_depth() + inflight
 
     # -- admission path ----------------------------------------------------
+
+    def _prefer_local(self, prompt) -> bool:
+        """Does the local decode engine hold enough cached prefix of
+        ``prompt`` that skipping the remote prefill hop wins? Threshold:
+        at least one full page, raised by ``PrefixConfig.min_shared_tokens``.
+        Probe failures just mean no preference — routing must never add a
+        failure mode."""
+        if not self.pcfg.route_by_prefix:
+            return False
+        try:
+            got = self.engine.prefix_match_tokens(prompt)
+        except Exception:  # noqa: BLE001 - probe only, degrade to no-pref
+            return False
+        ps = getattr(self.engine.ccfg, "page_size", 1)
+        return got >= max(self.pcfg.min_shared_tokens, ps)
 
     def _pick_prefill_node(self) -> Optional[dict]:
         from ..distributed.directory import DirectoryClient
@@ -368,6 +385,22 @@ class DisaggBackend(EngineBackend):
         fail: Optional[str] = None
         try:
             try:
+                if self._prefer_local(prompt):
+                    # Prefix-aware short-circuit: the LOCAL decode engine
+                    # already holds a useful cached prefix of this prompt —
+                    # shipping the whole prompt to the prefill pool would
+                    # recompute (and re-transfer) KV that one admission
+                    # tick can reuse in place.
+                    self.metrics.counter("routed_by_prefix")
+                    with self._hlock:
+                        gid = self.engine.submit(
+                            prompt, options, deadline=deadline
+                        )
+                        h.gen_id = gid
+                        self._handles[gid] = h
+                    if h.stop.is_set():
+                        self.engine.cancel(gid)
+                    return
                 node = self._pick_prefill_node()
                 # Optional grace for an empty pool (rolling restart of the
                 # prefill tier): poll until a node appears or the grace
@@ -745,9 +778,11 @@ class FleetBackend(Backend):
         disagg_cfg: Optional[DisaggConfig] = None,
         metrics: Optional[Metrics] = None,
         pool_wait_s: float = 2.0,
+        prefix_cfg: Optional[PrefixConfig] = None,
     ):
         self.relay_host, self.relay_port = relay_host, relay_port
         self.dcfg = disagg_cfg or DisaggConfig()
+        self.pcfg = prefix_cfg or PrefixConfig()
         self.metrics = metrics or Metrics()
         self._dead_after = self.dcfg.dead_after_s or self.dcfg.lease_ttl_s
         self._pool_wait_s = pool_wait_s
@@ -810,6 +845,28 @@ class FleetBackend(Backend):
             t.join(timeout=max(0.0, end - time.monotonic()))
 
     # -- per-request stream loop -------------------------------------------
+
+    def _pick_prefix(self, directory, prompt, dead_ids) -> Optional[dict]:
+        """The live decode node holding the longest advertised prefix of
+        ``prompt`` (``None`` when nothing useful matches — the caller falls
+        back to least-loaded). A directory blip or a matched-but-gone node
+        also yields ``None``: prefix routing is an optimization and must
+        never add a failure mode to placement."""
+        if not self.pcfg.route_by_prefix:
+            return None
+        try:
+            nid, tokens = directory.match_prefix(prompt)
+            if (nid is None or nid in dead_ids
+                    or tokens < max(self.pcfg.min_shared_tokens, 1)):
+                return None
+            for n in directory.alive():
+                if (n.get("node_id") == nid and n.get("role") == "decode"
+                        and not n.get("pending")):
+                    self.metrics.counter("routed_by_prefix")
+                    return n
+        except Exception:  # noqa: BLE001 - probe only, fall back
+            pass
+        return None
 
     def _emit(self, h: Handle, ev: TokenEvent) -> None:
         try:
@@ -970,7 +1027,15 @@ class FleetBackend(Backend):
             return True
 
         try:
-            node = pick(self._pool_wait_s)
+            # Prefix-aware routing: ask the directory which decode node
+            # already holds the longest cached prefix of this prompt and
+            # prefer it over plain least-loaded — the hit skips that much
+            # prefill. Initial placement only: recovery placement (pick())
+            # stays availability-first, and the dead node's advertisement
+            # died with its lease anyway.
+            node = self._pick_prefix(directory, prompt, dead_ids)
+            if node is None:
+                node = pick(self._pool_wait_s)
             if node is None:
                 fail = "error: no decode node registered"
                 return

@@ -49,6 +49,16 @@ METRICS = {
     "batched_prefills": ("counter", "Prefills served by batched dispatch"),
     "ring_prefills": ("counter", "Prefills served by the ring pipeline"),
     "prefix_cached_tokens": ("counter", "Prompt tokens served from prefix cache"),
+    # prefixstore: CoW sharing / host-DRAM spill tier / prefix routing
+    "prefix_hit_rate": ("gauge", "Cumulative fraction of prompt tokens reused"),
+    "prefix_pages_shared": ("counter", "Shared prefix-page attachments"),
+    "prefix_cow_copies": ("counter", "Copy-on-write splits of shared pages"),
+    "prefix_spill_bytes": ("gauge", "Host spill arena bytes resident"),
+    "prefix_spilled_pages": ("counter", "Prefix pages spilled to host DRAM"),
+    "prefix_spill_reloads": ("counter", "Prefix pages reloaded from the arena"),
+    "prefix_reload_ms": ("summary", "Host->device prefix page reload time"),
+    "prefix_reload_errors": ("counter", "Arena entries rejected at reload"),
+    "routed_by_prefix": ("counter", "Requests routed to a prefix-holding node"),
     "decode_step": ("summary", "One decode tick (dispatch+resolve)"),
     "decode_resolve": ("summary", "Deferred decode fetch latency"),
     "decode_tokens": ("counter", "Tokens emitted by decode"),

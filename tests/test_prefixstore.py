@@ -1,0 +1,448 @@
+"""Fleet-wide prefix/KV reuse (prefixstore/, ISSUE 14).
+
+The contract under test, layer by layer:
+
+* **index**: ``chain_keys_hex`` is byte-identical to the allocator's
+  canonical ``chain_keys`` (the directory must never import jax), and
+  ``match_tokens`` walks from the root only.
+* **CoW sharing**: concurrent sessions attaching to the same prompt
+  prefix change prefill WORK, never TOKENS — byte-exact with sharing on
+  vs off for greedy and sampled decode, f32 and int8 pools, including a
+  fully-matched page-aligned prompt (copy-on-write split) and re-use
+  after the split.
+* **refcount safety**: admit/evict/free churn never frees a referenced
+  page, never double-frees, and conserves the pool.
+* **spill tier**: evict -> host arena -> reload is bit-exact; a
+  corrupted arena entry degrades to recompute, never wedges admission.
+* **routing**: the directory returns the node with the longest
+  advertised prefix; gateways prefer it and fall back (never fail) when
+  the control plane drops or corrupts ``prefix.*`` traffic.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.cache.paged import PageAllocator
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    PrefixConfig,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.prefixstore import (
+    HostSpillArena,
+    chain_keys_hex,
+    match_tokens,
+)
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+PARAMS = None  # initialised lazily (model init costs ~1s; unit tests skip it)
+
+
+def _params():
+    global PARAMS
+    if PARAMS is None:
+        from distributed_llm_inference_tpu.models import llama
+
+        globals()["PARAMS"] = llama.init_params(
+            CFG, jax.random.PRNGKey(0), dtype=jnp.float32
+        )
+    return PARAMS
+
+
+PS = 8
+SYS = list(range(1, 25))  # 24 tokens = 3 full pages (shared system prompt)
+
+
+def _engine(prefix=True, share=True, spill=0, num_pages=64, quant=None,
+            batch=4):
+    return InferenceEngine(
+        CFG, _params(),
+        engine_cfg=EngineConfig(
+            max_batch_size=batch, max_seq_len=256, prefill_buckets=(8, 16, 32),
+        ),
+        cache_cfg=CacheConfig(
+            kind="paged", page_size=PS, num_pages=num_pages,
+            max_pages_per_session=16, prefix_caching=prefix, kv_quant=quant,
+        ),
+        prefix_cfg=PrefixConfig(prefix_share=share, spill_bytes_max=spill),
+    )
+
+
+# -- index contract -----------------------------------------------------------
+
+
+def test_chain_keys_hex_matches_allocator():
+    """The pure-python directory keys ARE the allocator's keys — a drift
+    here silently kills every cross-node prefix hit."""
+    for tokens in ([], [5], list(range(7)), list(range(8)),
+                   list(range(100)), [2**31 - 1, -1] * 8):
+        want = [k.hex() for k in PageAllocator.chain_keys(tokens, PS)]
+        assert chain_keys_hex(tokens, PS) == want
+    with pytest.raises(ValueError):
+        chain_keys_hex([1, 2], 0)
+
+
+def test_match_tokens_root_walk():
+    keys = chain_keys_hex(list(range(32)), PS)  # 4 pages
+    assert match_tokens(list(range(32)), PS, keys) == 32
+    assert match_tokens(list(range(32)), PS, keys[:2]) == 16
+    # A deeper key without its ancestors is unreachable: no credit.
+    assert match_tokens(list(range(32)), PS, keys[2:]) == 0
+    assert match_tokens(list(range(32)), PS, []) == 0
+    assert match_tokens(list(range(5)), PS, keys) == 0  # no full page
+
+
+# -- host spill arena ---------------------------------------------------------
+
+
+def test_arena_budget_lru_take():
+    tile = {"k": np.ones((2, 4), np.float32)}  # 32 bytes
+    a = HostSpillArena(max_bytes=70)
+    assert a.put(b"a", tile) and a.put(b"b", tile)
+    assert a.bytes_used == 64
+    assert a.put(b"c", tile)  # evicts oldest ("a")
+    assert b"a" not in a and b"b" in a and a.bytes_used == 64
+    # Oversize entry rejected outright; duplicate key rejected.
+    assert not a.put(b"big", {"k": np.ones((100,), np.float32)})
+    assert not a.put(b"b", tile)
+    got = a.take(b"b")
+    assert np.array_equal(got["k"], tile["k"])
+    assert b"b" not in a and a.bytes_used == 32
+    assert a.take(b"missing") is None
+
+
+# -- refcount safety under churn ---------------------------------------------
+
+
+def test_refcount_churn_stress():
+    """30 rounds of admit/evict/free churn: no page is ever freed (or
+    re-allocated) while a live chain still references it, nothing
+    double-frees, and the pool conserves pages."""
+    rng = random.Random(7)
+    alloc = PageAllocator(24)
+    live = []  # (pages, keys)
+
+    def on_evict(page, key):
+        # The invariant holds at EVICTION TIME: the page may be handed
+        # straight to the allocating session afterwards, but no live
+        # session may reference it at this instant.
+        held_now = {p for pages, _ in live for p in pages}
+        assert page not in held_now, f"evicted live page {page}"
+
+    alloc.on_evict = on_evict
+    prompts = [
+        [base + t for t in range(rng.randrange(8, 40))]
+        for base in (0, 1000, 2000, 0, 1000)  # overlapping chains
+    ]
+    for it in range(30):
+        # Admit: lookup + alloc + register, like _admit's paged branch.
+        prompt = rng.choice(prompts)
+        keys = PageAllocator.chain_keys(prompt, PS)
+        need = -(-(len(prompt) + 1) // PS)
+        shared = alloc.lookup(keys[: (len(prompt) - 1) // PS])
+        if need - len(shared) > alloc.free_count:
+            alloc.free(shared)
+        else:
+            pages = shared + alloc.alloc(need - len(shared))
+            for i, k in enumerate(keys):
+                if i < len(pages):
+                    alloc.register(pages[i], k)
+            live.append((pages, keys))
+        # Release a random session (register-then-free, like _release).
+        if live and rng.random() < 0.5:
+            pages, keys = live.pop(rng.randrange(len(live)))
+            alloc.free(pages)
+        # Invariants every round:
+        held = [p for pages, _ in live for p in pages]
+        for p in set(held):
+            # A referenced page can never sit on the free list, and its
+            # refcount covers every live holder (no premature free).
+            assert p not in alloc._free_set, f"round {it}: freed live page {p}"
+            assert alloc._refs.get(p, 0) >= held.count(p) > 0
+        # Double-free of an already-free page must raise, pool untouched.
+        if alloc._free:
+            before = (len(alloc._free), dict(alloc._refs))
+            with pytest.raises(ValueError):
+                alloc.free([alloc._free[0]])
+            assert (len(alloc._free), dict(alloc._refs)) == before
+    for pages, _ in live:
+        alloc.free(pages)
+    # Conservation: every page is back in free list or evictable LRU.
+    assert alloc.free_count == 23  # pages 1..23 (0 is the null page)
+
+
+# -- engine: byte-exact parity, sharing on vs off -----------------------------
+
+
+def _streams(e, opts):
+    """Sequential submissions (NOT same-tick): the parity contract is for
+    sequential arrivals — same-tick identical prompts legitimately change
+    batching shape, which under sampling changes the RNG draw order."""
+    p1 = SYS + [30, 31]
+    p2 = SYS + [40, 41, 42]
+    out = [e.generate([p1], opts)[0]]
+    out.append(e.generate([p2], opts)[0])
+    out.append(e.generate([SYS], opts)[0])   # page-aligned: CoW split
+    out.append(e.generate([p1], opts)[0])    # re-share after the split
+    return out
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+@pytest.mark.parametrize(
+    "opts",
+    [
+        SamplingOptions(max_new_tokens=5, eos_token_id=-1),
+        SamplingOptions(max_new_tokens=5, eos_token_id=-1,
+                        temperature=0.8, top_k=20),
+    ],
+    ids=["greedy", "sampled"],
+)
+def test_sharing_parity(quant, opts):
+    on = _streams(_engine(share=True, quant=quant), opts)
+    off = _streams(_engine(prefix=False, share=False, quant=quant), opts)
+    assert on == off
+    # And sharing actually happened (not a vacuous pass).
+    e = _engine(share=True, quant=quant)
+    ref = _streams(e, opts)
+    assert ref == off
+    snap = e.metrics.snapshot()
+    assert snap.get("prefix_cached_tokens", 0) >= 24
+    assert snap.get("prefix_cow_copies", 0) >= 1
+    assert snap.get("prefix_pages_shared", 0) >= 3
+    assert 0 < snap.get("prefix_hit_rate", 0) < 1
+
+
+def test_live_sharing_while_writer_decodes():
+    """Register-at-admission: a second session attaches to the FIRST
+    session's pages while the first is still decoding (no release in
+    between), and both streams stay byte-exact."""
+    opts = SamplingOptions(max_new_tokens=12, eos_token_id=-1)
+    e = _engine(share=True, batch=4)
+    a = e._submit_session(SYS + [30, 31], opts)
+    e.step()  # admit + prefill the writer; it keeps decoding
+    assert e.metrics.get_counter("prefix_cached_tokens") == 0
+    b = e._submit_session(SYS + [40, 41, 42], opts)
+    while e.has_work():
+        e.step()
+    assert e.metrics.get_counter("prefix_cached_tokens") >= 24
+    off = _engine(prefix=False, share=False)
+    assert a.generated == off.generate([SYS + [30, 31]], opts)[0]
+    assert b.generated == off.generate([SYS + [40, 41, 42]], opts)[0]
+
+
+# -- spill tier ---------------------------------------------------------------
+
+
+def test_spill_reload_round_trip():
+    opts = SamplingOptions(max_new_tokens=4, eos_token_id=-1)
+    pA, pB = list(range(1, 18)), list(range(50, 74))
+    e = _engine(share=True, spill=1 << 20, num_pages=6)  # 5 usable pages
+    rA = e.generate([pA], opts)[0]
+    rB = e.generate([pB], opts)[0]  # pressure evicts A's pages -> arena
+    snap = e.metrics.snapshot()
+    assert snap.get("prefix_spilled_pages", 0) >= 1
+    assert snap.get("prefix_spill_bytes", 0) > 0
+    rA2 = e.generate([pA], opts)[0]  # reload through the page-write path
+    snap = e.metrics.snapshot()
+    assert snap.get("prefix_spill_reloads", 0) >= 1
+    assert snap.get("prefix_reload_ms_count", 0) >= 1
+    assert snap.get("prefix_reload_errors", 0) == 0
+    s = _engine(prefix=False, share=False, num_pages=32)
+    assert [rA, rB, rA2] == [
+        s.generate([p], opts)[0] for p in (pA, pB, pA)
+    ]
+
+
+def test_corrupt_arena_entry_degrades_to_recompute():
+    opts = SamplingOptions(max_new_tokens=4, eos_token_id=-1)
+    pA, pB = list(range(1, 18)), list(range(50, 74))
+    e = _engine(share=True, spill=1 << 20, num_pages=6)
+    rA = e.generate([pA], opts)[0]
+    e.generate([pB], opts)
+    assert len(e._spill) >= 1
+    # Poison every arena entry (wrong shape): reload must REJECT them.
+    for key in list(e._spill.keys()):
+        tiles = e._spill.take(key)
+        e._spill.put(key, {n: t[..., :1] for n, t in tiles.items()})
+    rA2 = e.generate([pA], opts)[0]
+    assert rA2 == rA  # recomputed, byte-exact
+    snap = e.metrics.snapshot()
+    assert snap.get("prefix_reload_errors", 0) >= 1
+    assert snap.get("prefix_spill_reloads", 0) == 0
+
+
+# -- disaggregated admission: uniform metrics + shared attach -----------------
+
+
+def test_admit_prefilled_emits_prefix_metrics():
+    """The ISSUE-14 metrics fix: ``prefix_cached_tokens`` (and the hit-rate
+    gauge) must flow from admit_prefilled exactly like the local path, and
+    a local prefix hit skips re-ingesting the shared head."""
+    opts = SamplingOptions(max_new_tokens=4, eos_token_id=-1)
+    prompt = SYS + [30, 31]
+    prefiller = _engine(share=True)
+    decoder = _engine(share=True)
+    local = decoder.generate([prompt], opts)[0]  # seeds decoder's registry
+    planes, first, chain = prefiller.prefill_export(prompt, opts)
+    gid = decoder.admit_prefilled(prompt, planes, first, options=opts)
+    assert gid is not None
+    while decoder.has_work():
+        decoder.step()
+    got = decoder.collect_finished()[gid]
+    snap = decoder.metrics.snapshot()
+    assert snap.get("prefix_cached_tokens", 0) >= 24  # shared head attached
+    assert snap.get("prefix_hit_rate", 0) > 0
+    assert [first] + got.generated[1:] == got.generated  # sanity
+    assert got.generated == local
+
+
+# -- prefix-aware routing -----------------------------------------------------
+
+
+def _mk_directory():
+    from distributed_llm_inference_tpu.distributed.directory import (
+        BlockDirectory,
+    )
+
+    d = BlockDirectory(default_ttl=5.0)
+    d.register("node-a", 0, 1, "q.a", role="decode")
+    d.register("node-b", 0, 1, "q.b", role="decode")
+    return d
+
+
+def test_directory_match_longest_prefix():
+    d = _mk_directory()
+    keys = chain_keys_hex(SYS + list(range(100, 132)), PS)
+    assert d.advertise_prefixes("node-a", PS, keys[:1])
+    assert d.advertise_prefixes("node-b", PS, keys[:3])
+    nid, tokens = d.match_prefix(SYS + list(range(100, 132)))
+    assert (nid, tokens) == ("node-b", 24)
+    assert d.match_prefix([99] * 32) == (None, 0)
+    # Advertisement dies with the lease.
+    d.remove("node-b")
+    nid, tokens = d.match_prefix(SYS + list(range(100, 132)))
+    assert (nid, tokens) == ("node-a", 8)
+    # No lease -> advertisement refused.
+    assert not d.advertise_prefixes("node-gone", PS, keys)
+    # Prefill-only nodes never match (nothing decodes there).
+    d.register("node-p", 0, 1, "q.p", role="prefill")
+    d.advertise_prefixes("node-p", PS, keys)
+    nid, _ = d.match_prefix(SYS + list(range(100, 132)))
+    assert nid == "node-a"
+
+
+def test_fleet_pick_prefix_prefers_holder_and_falls_back():
+    from distributed_llm_inference_tpu.serving.backends import FleetBackend
+
+    b = FleetBackend(relay_port=1, prefix_cfg=PrefixConfig())
+    prompt = SYS + [30, 31]
+
+    class GoodDir:
+        def match_prefix(self, p, timeout=5.0):
+            return "node-b", 24
+
+        def alive(self):
+            return [
+                {"node_id": "node-a", "role": "decode", "load": 0},
+                {"node_id": "node-b", "role": "decode", "load": 3},
+            ]
+
+    picked = b._pick_prefix(GoodDir(), prompt, set())
+    assert picked and picked["node_id"] == "node-b"
+    assert b.metrics.get_counter("routed_by_prefix") == 1
+    # Matched node dead / control plane down / below threshold: fall back.
+    assert b._pick_prefix(GoodDir(), prompt, {"node-b"}) is None
+
+    class DeadDir:
+        def match_prefix(self, p, timeout=5.0):
+            raise TimeoutError("directory unreachable")
+
+    assert b._pick_prefix(DeadDir(), prompt, set()) is None
+    b2 = FleetBackend(
+        relay_port=1, prefix_cfg=PrefixConfig(min_shared_tokens=64),
+    )
+    assert b2._pick_prefix(GoodDir(), prompt, set()) is None
+    b3 = FleetBackend(
+        relay_port=1, prefix_cfg=PrefixConfig(route_by_prefix=False),
+    )
+    assert b3._pick_prefix(GoodDir(), prompt, set()) is None
+
+
+def test_disagg_prefer_local_probe():
+    from distributed_llm_inference_tpu.serving.backends import DisaggBackend
+
+    opts = SamplingOptions(max_new_tokens=2, eos_token_id=-1)
+    e = _engine(share=True)
+    e.generate([SYS + [30, 31]], opts)  # seed the local registry
+    b = DisaggBackend.__new__(DisaggBackend)  # probe only; no threads
+    b.engine = e
+    b.pcfg = PrefixConfig()
+    assert b._prefer_local(SYS + [77, 78])
+    assert not b._prefer_local([99] * 24)
+    b.pcfg = PrefixConfig(route_by_prefix=False)
+    assert not b._prefer_local(SYS + [77, 78])
+    b.pcfg = PrefixConfig(min_shared_tokens=1000)
+    assert not b._prefer_local(SYS + [77, 78])
+
+
+# -- chaos-lite: prefix control-plane faults never wedge routing --------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "spec", ["drop:directory.req:put:count=1", "corrupt:directory.req:put:count=1"]
+)
+def test_prefix_match_chaos_falls_back(spec):
+    """A dropped or corrupted ``prefix.match`` request times out at the
+    client; the gateway's prefix probe returns None (least-loaded
+    fallback) instead of wedging or crashing the request thread."""
+    from distributed_llm_inference_tpu.distributed import (
+        ChaosProxy,
+        DirectoryService,
+        FaultPlan,
+        FaultRule,
+        RelayServer,
+    )
+    from distributed_llm_inference_tpu.distributed.directory import (
+        DirectoryClient,
+    )
+    from distributed_llm_inference_tpu.serving.backends import FleetBackend
+
+    plan = FaultPlan([FaultRule.parse(spec)], seed=3)
+    with RelayServer() as relay:
+        with DirectoryService(relay.port, default_ttl=5.0) as svc:
+            svc.directory.register("node-a", 0, 1, "q.a", role="decode")
+            svc.directory.advertise_prefixes(
+                "node-a", PS, chain_keys_hex(SYS, PS)
+            )
+            with ChaosProxy("127.0.0.1", relay.port, plan=plan) as proxy:
+                with DirectoryClient(proxy.port) as dc:
+                    # The faulted request itself: times out, no wedge.
+                    with pytest.raises((TimeoutError, RuntimeError)):
+                        dc.match_prefix(SYS, timeout=1.0)
+
+                    class Dir:
+                        def match_prefix(self, p, timeout=5.0):
+                            return dc.match_prefix(p, timeout=1.0)
+
+                        def alive(self):
+                            return dc.alive()
+
+                    b = FleetBackend(
+                        relay_port=proxy.port, prefix_cfg=PrefixConfig(),
+                    )
+                    # Fault budget spent above — the NEXT probe succeeds
+                    # and routes by prefix; a fresh fault (new proxy plan)
+                    # would fall back to None, which pick() handles.
+                    picked = b._pick_prefix(Dir(), SYS, set())
+                    assert picked is None or picked["node_id"] == "node-a"

@@ -33,6 +33,13 @@
 # zero-token-loss session migration and ride the disagg block at the
 # end of the schedule.
 #
+# The prefix/KV-reuse contract tests (tests/test_prefixstore.py: hash
+# chain + allocator refcount-churn invariants, byte-exact sharing-on/off
+# parity incl. CoW splits and spill→reload, directory prefix routing,
+# and the chaos-lite prefix.* fault drills) are deliberately NOT marked
+# 'slow': they are the correctness gate for copy-on-write page sharing
+# — keep new cases under a few seconds each or move them to 'slow'.
+#
 # The admission-overlap contract tests (tests/test_engine.py, the
 # "overlapped (stall-free) admission" section: byte-exact parity with
 # overlap_admission on/off, cancel/deadline-during-inflight-prefill,
