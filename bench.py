@@ -1817,6 +1817,259 @@ def _prefix_phase() -> dict:
     return out
 
 
+def _traffic_phase() -> dict:
+    """Open-loop multi-tenant traffic harness (`--phase traffic`): a
+    Poisson arrival process per tenant fired at a real HTTP gateway —
+    arrivals never wait for completions, so queueing shows up as TTFT
+    tail growth instead of being absorbed by a closed loop's back-off.
+    Two adversarial tenants: "chat" (interactive lane, multi-turn
+    requests sharing a system prefix, modest max_tokens) and "scraper"
+    (batch lane, heavy-tailed prompt lengths, higher rate). Three runs
+    on identical seeds: interactive SOLO (its baseline), both tenants
+    under legacy FIFO admission, and both under the sched/ scheduler
+    (weighted-fair lanes + deadline shedding). Reports per-tenant
+    p50/p99 TTFT and p99 inter-token latency, goodput under an SLO
+    derived from the solo run, Jain's fairness index over per-tenant
+    token-satisfaction ratios, and the shed/reject counter split.
+    Acceptance targets: sched interactive p99 TTFT <= 2x solo, Jain
+    >= 0.8, any shedding happens before prefill dispatch (gateway
+    counters move, engine submission counters don't). CPU-scope and
+    opt-in like the other host-tier phases."""
+    import http.client
+    import random
+    import threading
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu":
+        return {"error": "backend already initialized non-cpu; run this "
+                         "phase in its own process",
+                "scope": "cpu-localhost"}
+    from distributed_llm_inference_tpu.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedConfig, ServingConfig,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import llama as llama_mod
+    from distributed_llm_inference_tpu.serving import ApiServer, EngineBackend
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+    )
+    params = llama_mod.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    WINDOW_S = 8.0
+    SYS_PREFIX = [(i * 37) % 96 + 2 for i in range(64)]  # shared chat prefix
+
+    def start_server(sched_on):
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch_size=4, max_seq_len=512,
+                         prefill_buckets=(32, 64, 128, 256),
+                         dtype="float32"),
+            CacheConfig(kind="paged", page_size=16, num_pages=512,
+                        max_pages_per_session=24, prefix_caching=True),
+        )
+        backend = EngineBackend(eng, idle_sleep_s=0.001)
+        scfg = ServingConfig(host="127.0.0.1", port=0, max_queue_depth=256)
+        server = ApiServer(
+            backend, scfg,
+            sched_cfg=SchedConfig() if sched_on else None,
+        )
+        server.start()
+        # Untimed warm-up: compile every prefill bucket + the decode step
+        # so the timed window measures queueing, not XLA compiles.
+        for n in (24, 56, 120, 250):
+            _do_request([3] * n, 4, "warmup", "interactive", 60.0,
+                        server.port, {})
+        if server.sched is not None:
+            # Warm-up TTFTs carry one-off compile time; drop them so the
+            # shed model learns only from steady-state samples.
+            server.sched.reset_estimator()
+        return server, backend
+
+    def _do_request(prompt, max_tokens, user, lane, timeout_s, port, rec):
+        """One streamed completion; fills `rec` with ttft/gaps/tokens."""
+        rec.setdefault("status", 0)
+        t0 = time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=timeout_s + 30.0
+            )
+            conn.request(
+                "POST", "/v1/completions",
+                json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                            "stream": True, "user": user, "lane": lane,
+                            "timeout_s": timeout_s}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            rec["status"] = resp.status
+            if resp.status != 200:
+                rec["code"] = json.loads(resp.read()).get(
+                    "error", {}).get("code")
+                conn.close()
+                return
+            last_t = None
+            for raw in resp:
+                if not raw.startswith(b"data: "):
+                    continue
+                payload = raw[len(b"data: "):].strip()
+                if payload == b"[DONE]":
+                    break
+                doc = json.loads(payload)
+                if doc["choices"][0]["token_ids"]:
+                    now = time.perf_counter()
+                    if last_t is None:
+                        rec["ttft"] = now - t0
+                    else:
+                        rec.setdefault("gaps", []).append(now - last_t)
+                    last_t = now
+                    rec["tokens"] = rec.get("tokens", 0) + 1
+                fr = doc["choices"][0].get("finish_reason")
+                if fr:
+                    rec["finish"] = fr
+            conn.close()
+        except Exception as e:  # connection death counts as a failure
+            rec["error"] = repr(e)[:80]
+
+    def make_workload(seed, include_batch):
+        """Deterministic open-loop schedule: [(arrival_s, kwargs)]."""
+        rng = random.Random(seed)
+        work = []
+        t = 0.0
+        while True:  # interactive "chat": ~3 req/s, shared-prefix turns
+            t += rng.expovariate(3.0)
+            if t >= WINDOW_S:
+                break
+            turn = [rng.randrange(2, 98) for _ in range(rng.randrange(8, 25))]
+            work.append((t, dict(prompt=SYS_PREFIX + turn, max_tokens=16,
+                                 user="chat", lane="interactive",
+                                 timeout_s=30.0)))
+        if include_batch:
+            t = 0.0
+            while True:  # batch "scraper": ~4 req/s, heavy-tailed lengths
+                t += rng.expovariate(4.0)
+                if t >= WINDOW_S:
+                    break
+                if rng.random() < 0.2:  # the heavy tail
+                    n = rng.randrange(192, 250)
+                else:
+                    n = rng.randrange(16, 33)
+                prompt = [rng.randrange(2, 98) for _ in range(n)]
+                work.append((t, dict(prompt=prompt, max_tokens=32,
+                                     user="scraper", lane="batch",
+                                     timeout_s=6.0)))
+        work.sort(key=lambda w: w[0])
+        return work
+
+    def run_traffic(sched_on, include_batch, seed=1234):
+        server, backend = start_server(sched_on)
+        try:
+            work = make_workload(seed, include_batch)
+            recs = [dict(user=kw["user"], requested=kw["max_tokens"])
+                    for _, kw in work]
+            threads = []
+            t0 = time.perf_counter()
+            for (at, kw), rec in zip(work, recs):
+                delay = at - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)  # open loop: fire on schedule
+                th = threading.Thread(
+                    target=_do_request, kwargs=dict(port=server.port,
+                                                    rec=rec, **kw),
+                    daemon=True,
+                )
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=60.0)
+            snap = backend.metrics.snapshot()
+        finally:
+            server.request_shutdown()
+            server.join(timeout=60.0)
+        return recs, snap
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(
+            vals[min(len(vals) - 1, int(q / 100.0 * len(vals)))] * 1e3, 1
+        )
+
+    def tenant_stats(recs, user, slo_s=None):
+        mine = [r for r in recs if r["user"] == user]
+        ttfts = [r["ttft"] for r in mine if "ttft" in r]
+        gaps = [g for r in mine for g in r.get("gaps", [])]
+        served = sum(r.get("tokens", 0) for r in mine)
+        requested = sum(r["requested"] for r in mine)
+        out = {
+            "requests": len(mine),
+            "ok": sum(1 for r in mine if r.get("finish") == "stop"
+                      or r.get("finish") == "length"),
+            "r429": sum(1 for r in mine if r["status"] == 429),
+            "ttft_ms_p50": pct(ttfts, 50), "ttft_ms_p99": pct(ttfts, 99),
+            "itl_ms_p99": pct(gaps, 99),
+            "satisfaction": round(served / max(requested, 1), 3),
+        }
+        if slo_s is not None:
+            good = sum(
+                r.get("tokens", 0) for r in mine
+                if r.get("ttft") is not None and r["ttft"] <= slo_s
+            )
+            out["goodput_tok_s"] = round(good / WINDOW_S, 1)
+        return out
+
+    def jain(xs):
+        if not xs or all(x == 0 for x in xs):
+            return 0.0
+        return round(sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs)), 3)
+
+    # Run 1 — interactive alone: the no-contention baseline the SLO and
+    # the "<= 2x solo" acceptance bar both come from.
+    solo_recs, _ = run_traffic(sched_on=True, include_batch=False)
+    solo = tenant_stats(solo_recs, "chat")
+    slo_s = max(0.25, 4.0 * (solo["ttft_ms_p50"] or 0.0) / 1e3)
+
+    # Run 2 — both tenants, legacy FIFO admission (scheduler off).
+    fifo_recs, fifo_snap = run_traffic(sched_on=False, include_batch=True)
+    # Run 3 — both tenants, scheduler on: weighted-fair lanes + shedding.
+    sched_recs, sched_snap = run_traffic(sched_on=True, include_batch=True)
+
+    def summarize(recs, snap):
+        chat = tenant_stats(recs, "chat", slo_s)
+        scraper = tenant_stats(recs, "scraper", slo_s)
+        return {
+            "chat": chat, "scraper": scraper,
+            "jain_fairness": jain(
+                [chat["satisfaction"], scraper["satisfaction"]]
+            ),
+            "shed_early": int(snap.get("sched_shed_early", 0)),
+            "rejected_rate_limit": int(
+                snap.get("sched_reject_rate_limit", 0)
+            ),
+            "engine_sessions_submitted": int(
+                snap.get("sessions_submitted", 0)
+            ),
+            "gateway_http_requests": int(snap.get("http_requests", 0)),
+        }
+
+    fifo = summarize(fifo_recs, fifo_snap)
+    sched = summarize(sched_recs, sched_snap)
+    solo_p99 = solo["ttft_ms_p99"] or 1e-9
+    sched_p99 = sched["chat"]["ttft_ms_p99"] or 0.0
+    return {
+        "scope": "cpu-localhost", "window_s": WINDOW_S,
+        "slo_ttft_ms": round(slo_s * 1e3, 1),
+        "solo_interactive": solo,
+        "fifo": fifo, "sched": sched,
+        "interactive_p99_vs_solo_x": round(sched_p99 / solo_p99, 2),
+        "targets": {"interactive_p99_vs_solo_x": "<=2.0 (sched on)",
+                    "jain_fairness": ">=0.8",
+                    "sheds_pre_prefill": "engine submits < gateway "
+                                         "requests when shed_early > 0"},
+    }
+
+
 def run_phase(name: str) -> dict:
     if name == "distributed":
         return _distributed_phase()
@@ -1826,6 +2079,8 @@ def run_phase(name: str) -> dict:
         return _recovery_phase()
     if name == "prefix":
         return _prefix_phase()
+    if name == "traffic":
+        return _traffic_phase()
     if name == "prefill":
         return _prefill_phase()
     on_tpu = jax.default_backend() == "tpu"

@@ -357,7 +357,13 @@ def cmd_local(args) -> int:
 def cmd_api(args) -> int:
     import jax.numpy as jnp
 
-    from .config import CacheConfig, DisaggConfig, EngineConfig, ServingConfig
+    from .config import (
+        CacheConfig,
+        DisaggConfig,
+        EngineConfig,
+        SchedConfig,
+        ServingConfig,
+    )
     from .serving import ApiServer, ClientBackend, DisaggBackend, EngineBackend
     from .utils import checkpoint
 
@@ -387,6 +393,25 @@ def cmd_api(args) -> int:
         breaker_recovery_s=args.breaker_recovery,
         breaker_probe_interval_s=args.breaker_probe_interval,
     )
+    sched_cfg = None
+    if args.sched:
+        weights = []
+        for spec in args.sched_weight or []:
+            tenant, _, w = spec.partition("=")
+            try:
+                weights.append((tenant, float(w)))
+            except ValueError:
+                raise SystemExit(
+                    f"--sched-weight {spec!r}: expected TENANT=WEIGHT"
+                )
+        sched_cfg = SchedConfig(
+            rate_tokens_per_s=args.sched_rate,
+            burst_tokens=args.sched_burst,
+            weights=tuple(weights),
+            batch_share=args.sched_batch_share,
+            shed_headroom=args.sched_shed_headroom,
+            max_lane_depth=args.sched_max_lane_depth,
+        )
     if args.disagg:
         # Disaggregated serving: the local engine is the DECODE pool
         # member; prompt prefill routes to role="prefill" workers (the
@@ -415,6 +440,7 @@ def cmd_api(args) -> int:
                 transfer_timeout_s=args.transfer_timeout,
             ),
             idle_sleep_s=scfg.idle_sleep_s,
+            sched_cfg=sched_cfg,
         )
     elif args.relay:
         from .distributed.client import DistributedClient
@@ -448,7 +474,8 @@ def cmd_api(args) -> int:
             CacheConfig(kind=args.cache, kv_quant=args.kv_quant),
         )
         backend = EngineBackend(engine, idle_sleep_s=scfg.idle_sleep_s)
-    server = ApiServer(backend, scfg, tokenizer=tokenizer)
+    server = ApiServer(backend, scfg, tokenizer=tokenizer,
+                       sched_cfg=sched_cfg)
     server.serve_forever(ready_cb=lambda port: print(
         json.dumps({"event": "api_up", "port": port}), flush=True
     ))
@@ -731,6 +758,32 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("int8", "int4", "int8_outlier"))
     a.add_argument("--weights-cache", default=None,
                    help="directory for pre-converted weight caching")
+    a.add_argument("--sched", action="store_true",
+                   help="enable the multi-tenant admission scheduler "
+                        "(sched/): tenant identity + rate limits, "
+                        "weighted-fair interactive/batch lanes, "
+                        "deadline-aware shedding")
+    a.add_argument("--sched-rate", type=float, default=0.0,
+                   help="per-tenant token budget refill rate "
+                        "(prompt+max_tokens per second; 0 = no rate limit)")
+    a.add_argument("--sched-burst", type=float, default=0.0,
+                   help="per-tenant token-bucket burst capacity "
+                        "(0 = 2 seconds of --sched-rate)")
+    a.add_argument("--sched-weight", action="append", default=None,
+                   metavar="TENANT=W",
+                   help="per-tenant fair-share weight (repeatable); "
+                        "unlisted tenants get weight 1.0")
+    a.add_argument("--sched-batch-share", type=float, default=0.125,
+                   help="fraction of admissions reserved for the batch "
+                        "lane under interactive pressure (0 = strict "
+                        "priority, batch may starve)")
+    a.add_argument("--sched-shed-headroom", type=float, default=1.0,
+                   help="shed a request at admission when its estimated "
+                        "TTFT exceeds headroom * remaining deadline "
+                        "(0 disables shedding)")
+    a.add_argument("--sched-max-lane-depth", type=int, default=256,
+                   help="pending tickets allowed per lane before "
+                        "queue-full 429s")
     a.set_defaults(fn=cmd_api)
 
     c = sub.add_parser(

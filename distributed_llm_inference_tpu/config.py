@@ -322,6 +322,52 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SchedConfig:
+    """SLO-aware multi-tenant admission policy (``sched/``): tenant
+    identity + token-bucket rate limits, a weighted-fair admission queue
+    with ``interactive``/``batch`` priority lanes the engine honors when
+    picking sessions each tick, deadline-aware shedding at admission, and
+    the locality-vs-load placement weighting the routing backends share.
+    Scheduling reorders ADMISSIONS only — per-request token streams are
+    byte-exact with the scheduler on or off."""
+
+    # Tenant a request lands on when it carries no API key (Authorization
+    # bearer / x-api-key header) and no "user" field.
+    default_tenant: str = "anon"
+    # Lane when the request body names none ("interactive" | "batch").
+    default_lane: str = "interactive"
+    # Per-tenant token-bucket rate limit over TOKEN cost (prompt tokens +
+    # max_tokens — big prompts pay for their weight). 0 disables rate
+    # limiting. Rejections are 429s whose Retry-After is the bucket's
+    # actual refill time for this request, not a constant.
+    rate_tokens_per_s: float = 0.0
+    # Bucket capacity (burst allowance) in tokens; 0 = 2 s of rate.
+    burst_tokens: float = 0.0
+    # Weighted-fair queue: virtual-time shares. Per-tenant weight
+    # overrides as (tenant, weight) pairs; everyone else gets the default.
+    default_weight: float = 1.0
+    weights: Tuple[Tuple[str, float], ...] = ()
+    # Guaranteed batch-lane admission share under interactive pressure
+    # (anti-starvation): one batch candidate is interleaved after every
+    # ~1/batch_share - 1 interactive picks. 0 = strict priority.
+    batch_share: float = 0.125
+    # Pending (admitted, pre-first-token) requests per lane before new
+    # ones get 429 queue_full.
+    max_lane_depth: int = 256
+    # Deadline-aware shedding: reject at admission (before any prefill
+    # FLOPs) when the EMA-estimated queue wait + prefill time exceeds the
+    # request's remaining deadline times this headroom factor. <1 sheds
+    # more eagerly; 0 disables.
+    shed_headroom: float = 1.0
+    # EMA smoothing for the prefill-rate / queue-wait estimator.
+    ema_alpha: float = 0.2
+    # Placement hint weighting: matched prefix tokens equivalent to one
+    # unit of node load. A prefix holder wins the routing decision only
+    # while its extra load, scaled by this, stays under the match length.
+    locality_tokens_per_load: float = 256.0
+
+
+@dataclasses.dataclass(frozen=True)
 class PrefixConfig:
     """Fleet-wide prefix/KV reuse policy (``prefixstore/``): copy-on-write
     shared prefix pages inside one engine, a bounded host-DRAM spill tier

@@ -36,6 +36,11 @@ _build_lock = threading.Lock()
 
 OP_PUT, OP_GET, OP_PING, OP_CANCEL = 1, 2, 3, 4
 CANCEL_ACK = (1 << 64) - 1
+# Ceiling on how long a frame already in flight may stall between bytes
+# before the client treats it as lost and recycles the connection. Far
+# above any legit hub→client delivery (frames cap at a few MiB), so the
+# only thing it ever fires on is a wedged or fault-injected stream.
+MID_FRAME_STALL_S = 30.0
 
 
 def frame_crc(payload: bytes) -> int:
@@ -287,9 +292,10 @@ class RelayClient:
             raise ConnectionError("relay client is closed")
         q = queue.encode()
         sock.sendall(struct.pack(">BH", OP_GET, len(q)) + q)
-        # Timeout applies only to the FIRST byte: once the hub has started a
-        # reply it will deliver the whole frame, and timing out mid-frame
-        # would desync the stream (discarded partial length/payload bytes).
+        # The caller's timeout applies only to the FIRST byte: once the hub
+        # has started a reply it is expected to deliver the whole frame, so
+        # a mid-frame timeout would normally desync the stream (discarded
+        # partial length/payload bytes).
         sock.settimeout(timeout)
         try:
             first = sock.recv(1)
@@ -300,8 +306,22 @@ class RelayClient:
             self._settimeout(None)
         if not first:
             raise ConnectionError("relay connection closed")
-        (length,) = struct.unpack(">Q", first + self._recv_exact(7))
-        return self._recv_payload(length, queue)
+        # A started frame must keep flowing. With unbounded mid-frame reads,
+        # a half-delivered frame (fault-injected truncation, wedged hub)
+        # blocks the caller forever — even `get(timeout=...)` hangs. Bound
+        # the remainder generously and surface a stall as a reconnectable
+        # ConnectionError; the fresh connection cures the desync.
+        self._settimeout(MID_FRAME_STALL_S)
+        try:
+            (length,) = struct.unpack(">Q", first + self._recv_exact(7))
+            return self._recv_payload(length, queue)
+        except socket.timeout as exc:
+            self._reconnect()
+            raise ConnectionError(
+                f"frame on {queue!r} stalled mid-delivery: treated as lost"
+            ) from exc
+        finally:
+            self._settimeout(None)
 
     def _settimeout(self, value) -> None:
         sock = self._sock

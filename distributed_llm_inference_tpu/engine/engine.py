@@ -565,6 +565,8 @@ class InferenceEngine:
         # them into its own event list so streaming consumers see every
         # token through the one event channel they already poll.
         self._ext_produced: List[Tuple[str, int, bool]] = []
+        # Admission-ordering hook (set_admission_order): None = FIFO.
+        self._admission_order = None
         # Any tail-capable cache pipelines (dense kinds and the paged pools'
         # fused windows); the sink ring (no tail) and draft-model engines
         # keep the synchronous flow.
@@ -1096,15 +1098,23 @@ class InferenceEngine:
         prompt: Sequence[int],
         options: Optional[SamplingOptions] = None,
         deadline: Optional[float] = None,
+        sched_key: Optional[tuple] = None,
     ) -> str:
         """Queue a prompt; returns its generation_id. Thread-safe.
 
         ``deadline`` is an absolute ``time.monotonic()`` instant: past it the
         scheduler reaps the session like a cancel (finish_reason
-        ``"deadline"``), whether it is still queued or actively decoding."""
-        return self._submit_session(prompt, options, deadline).generation_id
+        ``"deadline"``), whether it is still queued or actively decoding.
 
-    def _submit_session(self, prompt, options, deadline=None) -> Session:
+        ``sched_key`` is the gateway scheduler's admission-ordering stamp
+        (see :meth:`set_admission_order`); sessions without one are
+        admitted FIFO."""
+        return self._submit_session(
+            prompt, options, deadline, sched_key=sched_key
+        ).generation_id
+
+    def _submit_session(self, prompt, options, deadline=None,
+                        sched_key=None) -> Session:
         # Lock-free on purpose: step() holds the scheduler lock across whole
         # device steps (hundreds of ms at 7B shapes), and request-handler
         # threads must not stall on it. deque.append and dict insertion are
@@ -1116,11 +1126,24 @@ class InferenceEngine:
             prompt=list(prompt),
             options=options or SamplingOptions(),
             deadline=deadline,
+            sched_key=sched_key,
         )
         self.sessions[s.generation_id] = s
         self.waiting.append(s)
         self.metrics.counter("sessions_submitted")
         return s
+
+    def set_admission_order(self, fn) -> None:
+        """Install the gateway scheduler's admission-ordering hook:
+        ``fn(pending_sessions) -> ordered_sessions``, called under the
+        engine lock at each tick with the reaped waiting queue. The
+        engine admits a PREFIX of the returned order (free slots and
+        page-pool pressure permitting) instead of FIFO-popping. The hook
+        must be a pure reordering — a result that drops or invents
+        sessions is discarded and the tick falls back to FIFO. Ordering
+        affects WHICH sessions are admitted each tick, never the tokens
+        any individual session produces. ``None`` restores FIFO."""
+        self._admission_order = fn
 
     def cancel(self, generation_id: str) -> None:
         """Thread-safe and non-blocking: sets a monotonic flag; the
@@ -1884,20 +1907,20 @@ class InferenceEngine:
                 produced.append((gid, -1, True))
         self._shrink_if_idle()
         admitted: List[Tuple[Session, int]] = []
-        for slot in range(self.batch):
-            if self.slots[slot] is not None:
-                continue
-            # Drain cancelled/expired entries at the queue head WITHOUT
-            # advancing past this free slot — a real session behind them
-            # must not wait an extra tick per cancelled entry.
-            while self.waiting and (
-                self.waiting[0].cancel_requested
-                or (
-                    self.waiting[0].deadline is not None
-                    and now >= self.waiting[0].deadline
-                )
-            ):
-                dropped = self.waiting.popleft()
+        free_slots = [i for i in range(self.batch) if self.slots[i] is None]
+        candidates: List[Session] = []
+        if free_slots and self.waiting:
+            # Reap cancelled/expired entries anywhere in the queue (the
+            # FIFO path only ever saw them at the head; with ordered
+            # admission a cancelled mid-queue entry must not linger just
+            # because the scheduler ranks it low). Each reap emits the
+            # terminal event streaming consumers are owed.
+            for dropped in [
+                w for w in self.waiting
+                if w.cancel_requested
+                or (w.deadline is not None and now >= w.deadline)
+            ]:
+                self.waiting.remove(dropped)
                 dropped.state = SessionState.CANCELLED
                 if dropped.cancel_requested:
                     dropped.finish_reason = "cancelled"
@@ -1905,11 +1928,29 @@ class InferenceEngine:
                     dropped.finish_reason = "deadline"
                     self.metrics.counter("sessions_deadline_expired")
                 produced.append((dropped.generation_id, -1, True))
-            if not self.waiting:
-                continue
-            s = self.waiting[0]
+            candidates = list(self.waiting)
+            if self._admission_order is not None and len(candidates) > 1:
+                # Scheduler-ordered admission (sched/): the hook ranks the
+                # pending sessions; the tick admits a prefix of its order.
+                # Defensive: a result that is not a permutation of the
+                # queue is discarded — a buggy policy must never lose or
+                # invent sessions.
+                try:
+                    ordered = list(self._admission_order(candidates))
+                except Exception:  # noqa: BLE001 - policy must not kill ticks
+                    ordered = candidates
+                if len(ordered) == len(candidates) and (
+                    {id(x) for x in ordered} == {id(x) for x in candidates}
+                ):
+                    candidates = ordered
+        ci = 0
+        for slot in free_slots:
+            if ci >= len(candidates):
+                break
+            s = candidates[ci]
+            ci += 1
             if not self._capacity_ok(s):
-                self.waiting.popleft()
+                self.waiting.remove(s)
                 self._finish(s, "capacity", produced)
                 self.metrics.counter("sessions_rejected")
                 continue
@@ -1993,7 +2034,7 @@ class InferenceEngine:
                             if i >= len(s.pages):
                                 break
                             self.allocator.register(s.pages[i], key)
-            self.waiting.popleft()
+            self.waiting.remove(s)
             s.slot = slot
             s.state = SessionState.ACTIVE
             self.slots[slot] = s.generation_id

@@ -74,6 +74,11 @@ class Session:
     # snapshot (engine.resume_session). Carried through checkpoints so a
     # twice-migrated session reports 2, not 1.
     resumes: int = 0
+    # Admission-ordering stamp from the gateway scheduler (sched/): a
+    # sortable ``(lane_rank, virtual_finish, seq)`` tuple consumed by the
+    # engine's admission-order hook. None = direct engine user, admitted
+    # in FIFO order ahead of scheduled sessions.
+    sched_key: Optional[tuple] = None
     # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

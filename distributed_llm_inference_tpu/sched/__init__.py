@@ -1,0 +1,56 @@
+"""SLO-aware multi-tenant admission scheduling (the gateway's policy
+layer, factored out of ``serving/backends.py``).
+
+One :class:`Scheduler` instance sits in front of whichever backend the
+gateway serves (EngineBackend, DisaggBackend, ClientBackend,
+FleetBackend) and owns four concerns the FIFO queue conflated:
+
+* **Tenant identity + rate limits** (:mod:`.tenant`): requests map to a
+  tenant (API key header, body ``user`` field, or the default tenant)
+  with a per-tenant token bucket over token cost (prompt + max_tokens).
+  A limited request gets a 429 whose ``Retry-After`` is the bucket's
+  actual refill time for that request.
+* **Weighted-fair ordering** (:class:`.scheduler.Scheduler`): admitted
+  requests carry a virtual-finish-time stamp (start-time fair queuing
+  over token cost, weighted per tenant) in one of two priority lanes —
+  ``interactive`` ahead of ``batch``, with a guaranteed batch share so
+  saturation never starves it. The engine's admission hook
+  (``InferenceEngine.set_admission_order``) consumes the ordering each
+  tick instead of FIFO-popping.
+* **Deadline-aware shedding** (:mod:`.estimator`): a rolling EMA of
+  prefill rate and queue wait prices each request's time-to-first-token
+  at admission; one that would blow its deadline anyway is rejected
+  BEFORE it burns prefill FLOPs (``sched_shed_early``).
+* **Placement hints** (:mod:`.placement`): one scoring rule weighing
+  ``BlockDirectory.match_prefix`` locality against node load, shared by
+  FleetBackend and DisaggBackend so routing and scheduling stop making
+  contradictory choices.
+
+Scheduling reorders ADMISSIONS only: per-request token streams stay
+byte-exact with the scheduler on or off.
+"""
+
+from .estimator import LatencyEstimator
+from .placement import choose_decode_node, placement_score, prefix_worth_detour
+from .scheduler import (
+    LANE_BATCH,
+    LANE_INTERACTIVE,
+    AdmissionDecision,
+    Scheduler,
+    Ticket,
+)
+from .tenant import TokenBucket, resolve_tenant
+
+__all__ = [
+    "AdmissionDecision",
+    "LANE_BATCH",
+    "LANE_INTERACTIVE",
+    "LatencyEstimator",
+    "Scheduler",
+    "Ticket",
+    "TokenBucket",
+    "choose_decode_node",
+    "placement_score",
+    "prefix_worth_detour",
+    "resolve_tenant",
+]

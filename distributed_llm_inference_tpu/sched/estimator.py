@@ -1,0 +1,72 @@
+"""Rolling TTFT estimator for deadline-aware admission shedding.
+
+Two EMAs, both fed from observed gateway TTFT samples:
+
+* ``prefill_s_per_tok`` — learned only from samples admitted against an
+  EMPTY queue (their TTFT is pure prefill + dispatch, no queue wait), so
+  queueing never inflates the per-token rate itself.
+* ``queue_extra_s`` — the residual between observed TTFT and the token
+  model's prediction (scheduler overhead, tick quantization, decode
+  contention). Clamped at zero: a lucky fast sample must not drive the
+  estimate negative.
+
+``estimate(prompt_tokens, backlog_tokens)`` prices a NEW request: the
+backlog ahead of it must prefill first, then its own prompt, plus the
+residual. Until the first empty-queue sample lands the estimator
+abstains (returns ``None``) — cold starts must never mass-shed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class LatencyEstimator:
+    """Not thread-safe by itself — callers serialize (the Scheduler
+    owns one under its lock)."""
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self.prefill_s_per_tok: Optional[float] = None
+        self.queue_extra_s = 0.0
+
+    def _ema(self, old: Optional[float], sample: float) -> float:
+        if old is None:
+            return sample
+        return (1.0 - self.alpha) * old + self.alpha * sample
+
+    def observe(self, ttft_s: float, prompt_tokens: int,
+                backlog_tokens: float) -> None:
+        """One finished admission: its observed TTFT, its own prompt
+        length, and the pending token cost that was queued ahead of it
+        when it was admitted."""
+        if ttft_s < 0 or prompt_tokens <= 0:
+            return
+        if backlog_tokens <= 0:
+            self.prefill_s_per_tok = self._ema(
+                self.prefill_s_per_tok, ttft_s / prompt_tokens
+            )
+        if self.prefill_s_per_tok is not None:
+            pred = (prompt_tokens + backlog_tokens) * self.prefill_s_per_tok
+            self.queue_extra_s = max(
+                0.0, self._ema(self.queue_extra_s, ttft_s - pred)
+            )
+
+    def estimate(self, prompt_tokens: int,
+                 backlog_tokens: float) -> Optional[float]:
+        """Predicted TTFT for a request admitted NOW, or ``None`` while
+        unlearned (no empty-queue sample yet)."""
+        if self.prefill_s_per_tok is None:
+            return None
+        return (
+            (prompt_tokens + backlog_tokens) * self.prefill_s_per_tok
+            + self.queue_extra_s
+        )
+
+    def queue_wait(self, ttft_s: float, prompt_tokens: int) -> float:
+        """The sample's queue-wait component: observed TTFT minus the
+        modeled cost of its own prefill (for the ``sched_queue_wait``
+        summary). Zero while the rate is unlearned."""
+        if self.prefill_s_per_tok is None:
+            return 0.0
+        return max(0.0, ttft_s - prompt_tokens * self.prefill_s_per_tok)

@@ -1,0 +1,67 @@
+"""Placement hints: one rule for the locality-vs-load tradeoff.
+
+PR 9 gave the routing backends a prefix signal (``BlockDirectory.
+match_prefix``) but each applied it with its own ad-hoc rule —
+FleetBackend preferred ANY live prefix holder over the least-loaded
+node, DisaggBackend kept prompts local on any page-sized match — so
+routing could pile requests onto a hot prefix holder the scheduler was
+simultaneously trying to drain. This module is the shared arbiter: a
+matched prefix is worth exactly ``SchedConfig.locality_tokens_per_load``
+tokens per unit of extra load, nothing more.
+
+Score = ``load * locality_tokens_per_load - matched_tokens``; lower
+wins. A holder beats the least-loaded alternative only while its extra
+load, priced in tokens, stays under the prefill work the match saves.
+All functions are pure and failure-free on weird inputs — placement is
+an optimization and must never add a failure mode to routing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..config import SchedConfig
+
+
+def placement_score(load: float, matched_tokens: float,
+                    cfg: SchedConfig) -> float:
+    """Lower is better. ``load`` is the node's advertised load (active
+    streams); ``matched_tokens`` the prefix length it already holds."""
+    return float(load) * cfg.locality_tokens_per_load - float(matched_tokens)
+
+
+def prefix_worth_detour(matched_tokens: float, holder_load: float,
+                        alt_load: float, cfg: SchedConfig) -> bool:
+    """Does routing to the prefix holder beat the least-loaded
+    alternative (which matches nothing)? Ties go to the holder — reuse
+    is free when the loads are equal."""
+    return placement_score(holder_load, matched_tokens, cfg) <= placement_score(
+        alt_load, 0.0, cfg
+    )
+
+
+def choose_decode_node(
+    nodes: List[Dict],
+    match_node_id: Optional[str],
+    matched_tokens: float,
+    cfg: SchedConfig,
+) -> Optional[Dict]:
+    """Pick the serving node from directory ``alive()`` rows: the best
+    placement score, counting ``matched_tokens`` only for the node that
+    actually holds the prefix. Deterministic tie-break by (load,
+    node_id) so tests and replays are stable."""
+    best: Optional[Dict] = None
+    best_key = None
+    for n in nodes:
+        load = float(n.get("load", 0) or 0)
+        matched = (
+            matched_tokens if (
+                match_node_id is not None
+                and n.get("node_id") == match_node_id
+            ) else 0.0
+        )
+        key = (placement_score(load, matched, cfg), load,
+               str(n.get("node_id", "")))
+        if best_key is None or key < best_key:
+            best, best_key = n, key
+    return best

@@ -1,0 +1,268 @@
+"""The admission scheduler: weighted-fair lanes, rate limits, shedding.
+
+Ordering is start-time fair queuing over TOKEN cost: each admitted
+request is stamped with a virtual finish time ``vstart + cost/weight``
+where ``vstart = max(scheduler vtime, tenant's last vfinish)`` — a
+tenant's big prompt pushes ITS next request back, not everyone's, and an
+idle tenant re-enters at the current virtual time instead of banking
+unbounded credit. The engine's admission hook sorts pending sessions by
+``(lane, vfinish, seq)`` each tick, with one batch-lane candidate
+interleaved after every ``~1/batch_share - 1`` interactive picks so a
+saturating interactive tenant cannot starve batch forever.
+
+Thread model: ``admit``/``note_*`` run on the gateway's event loop;
+``order_sessions`` runs on the engine driver thread under the engine
+lock. One scheduler lock guards all mutable state; every operation under
+it is O(pending) in-memory work — no blocking calls, no device work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..config import SchedConfig
+from ..utils.metrics import Metrics
+from .estimator import LatencyEstimator
+from .tenant import TokenBucket, resolve_tenant
+
+LANE_INTERACTIVE = "interactive"
+LANE_BATCH = "batch"
+_LANES = (LANE_INTERACTIVE, LANE_BATCH)
+_LANE_RANK = {LANE_INTERACTIVE: 0, LANE_BATCH: 1}
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One admitted request's scheduling stamp. Carried by the backend
+    into the engine as ``Session.sched_key`` and handed back to the
+    scheduler at first token / finish for accounting."""
+
+    tenant: str
+    lane: str
+    cost: float  # prompt_tokens + max_tokens
+    prompt_tokens: int
+    vstart: float
+    vfinish: float
+    seq: int
+    submit_t: float
+    backlog_tokens: float  # pending token cost ahead at admission
+    started: bool = False  # first token observed
+    closed: bool = False
+
+    @property
+    def sort_key(self) -> Tuple[int, float, int]:
+        return (_LANE_RANK.get(self.lane, 0), self.vfinish, self.seq)
+
+
+@dataclasses.dataclass
+class AdmissionDecision:
+    """``ok`` with a ticket, or a rejection with the reason the gateway
+    maps to its 429 code (``rate_limit`` | ``queue_full`` | ``shed``)
+    and, when meaningful, a computed Retry-After."""
+
+    ok: bool
+    reason: Optional[str] = None
+    retry_after_s: Optional[float] = None
+    ticket: Optional[Ticket] = None
+
+
+class _TenantState:
+    __slots__ = ("bucket", "weight", "vfinish")
+
+    def __init__(self, bucket: TokenBucket, weight: float):
+        self.bucket = bucket
+        self.weight = weight
+        self.vfinish = 0.0
+
+
+class Scheduler:
+    """One per gateway; shared by whichever backend it fronts."""
+
+    def __init__(self, cfg: Optional[SchedConfig] = None,
+                 metrics: Optional[Metrics] = None):
+        self.cfg = cfg or SchedConfig()
+        self.metrics = metrics or Metrics()
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantState] = {}
+        self._weights = dict(self.cfg.weights)
+        self._vtime = 0.0
+        self._seq = 0
+        self._depth = {lane: 0 for lane in _LANES}
+        self._pending_tokens = {lane: 0.0 for lane in _LANES}
+        self._est = LatencyEstimator(alpha=self.cfg.ema_alpha)
+        with self._lock:
+            self._publish_depths()
+
+    # -- identity ----------------------------------------------------------
+
+    def resolve(self, headers, user: Optional[str]) -> str:
+        return resolve_tenant(headers, user, self.cfg.default_tenant)
+
+    def lane_of(self, requested: Optional[str]) -> str:
+        lane = requested or self.cfg.default_lane
+        return lane if lane in _LANES else LANE_INTERACTIVE
+
+    # -- admission ---------------------------------------------------------
+
+    def _tenant(self, tenant: str) -> _TenantState:
+        ts = self._tenants.get(tenant)
+        if ts is None:
+            ts = _TenantState(
+                TokenBucket(self.cfg.rate_tokens_per_s, self.cfg.burst_tokens),
+                float(self._weights.get(tenant, self.cfg.default_weight)),
+            )
+            self._tenants[tenant] = ts
+        return ts
+
+    def _publish_depths(self) -> None:
+        for lane in _LANES:
+            self.metrics.gauge(f"sched_lane_depth_{lane}", self._depth[lane])
+
+    def admit(
+        self,
+        tenant: str,
+        lane: str,
+        prompt_tokens: int,
+        max_tokens: int,
+        deadline: Optional[float],
+        now: Optional[float] = None,
+    ) -> AdmissionDecision:
+        """Price and stamp one request. Rejections burn no engine work:
+        rate-limited and shed requests never reach ``backend.submit``."""
+        if now is None:
+            now = time.monotonic()
+        cost = float(prompt_tokens + max_tokens)
+        with self._lock:
+            ts = self._tenant(tenant)
+            wait = ts.bucket.try_take(cost, now)
+            if wait is not None:
+                self.metrics.counter("sched_reject_rate_limit")
+                return AdmissionDecision(
+                    False, reason="rate_limit", retry_after_s=wait
+                )
+            if self._depth[lane] >= self.cfg.max_lane_depth:
+                self.metrics.counter("sched_reject_queue_full")
+                return AdmissionDecision(
+                    False, reason="queue_full",
+                    retry_after_s=self._drain_eta_locked(),
+                )
+            backlog = sum(self._pending_tokens.values())
+            if self.cfg.shed_headroom > 0 and deadline is not None:
+                est = self._est.estimate(prompt_tokens, backlog)
+                if est is not None and (
+                    est > (deadline - now) * self.cfg.shed_headroom
+                ):
+                    self.metrics.counter("sched_shed_early")
+                    return AdmissionDecision(False, reason="shed")
+            vstart = max(self._vtime, ts.vfinish)
+            vfinish = vstart + cost / max(ts.weight, 1e-9)
+            ts.vfinish = vfinish
+            self._seq += 1
+            t = Ticket(
+                tenant=tenant, lane=lane, cost=cost,
+                prompt_tokens=prompt_tokens, vstart=vstart, vfinish=vfinish,
+                seq=self._seq, submit_t=now, backlog_tokens=backlog,
+            )
+            self._depth[lane] += 1
+            self._pending_tokens[lane] += cost
+            self._publish_depths()
+            self.metrics.counter("sched_admitted")
+            self.metrics.counter(f"sched_tenant_admit_{tenant}")
+            return AdmissionDecision(True, ticket=t)
+
+    def _drain_eta_locked(self) -> Optional[float]:
+        """Rough Retry-After for a full lane: pending prefill work at
+        the learned rate. None while the rate is unlearned (the gateway
+        falls back to its configured constant)."""
+        est = self._est.estimate(0, sum(self._pending_tokens.values()))
+        return est if est and est > 0 else None
+
+    # -- engine admission ordering -----------------------------------------
+
+    def order_sessions(self, sessions: Sequence) -> List:
+        """The engine hook: order pending sessions for this tick's free
+        slots. Sessions without a ``sched_key`` (direct engine users)
+        keep FIFO order ahead of scheduled ones — legacy behavior, and
+        they carry no lane/vtime to rank by. Must never raise: the
+        engine falls back to FIFO on any error, but don't lean on it."""
+        unscheduled, inter, batch = [], [], []
+        for i, s in enumerate(sessions):
+            key = getattr(s, "sched_key", None)
+            if key is None:
+                unscheduled.append((i, s))
+            elif key[0] == _LANE_RANK[LANE_BATCH]:
+                batch.append((key, i, s))
+            else:
+                inter.append((key, i, s))
+        inter.sort(key=lambda t: (t[0], t[1]))
+        batch.sort(key=lambda t: (t[0], t[1]))
+        share = self.cfg.batch_share
+        stride = (
+            max(1, int(round(1.0 / share)) - 1) if share > 0 else None
+        )
+        out: List = [s for _, s in unscheduled]
+        ii = bi = run = 0
+        while ii < len(inter) or bi < len(batch):
+            take_batch = bi < len(batch) and (
+                ii >= len(inter) or (stride is not None and run >= stride)
+            )
+            if take_batch:
+                out.append(batch[bi][2])
+                bi += 1
+                run = 0
+            else:
+                out.append(inter[ii][2])
+                ii += 1
+                run += 1
+        return out
+
+    # -- lifecycle accounting ----------------------------------------------
+
+    def _retire_locked(self, t: Ticket) -> None:
+        if t.started or t.closed:
+            return
+        t.started = True
+        self._depth[t.lane] -= 1
+        self._pending_tokens[t.lane] = max(
+            0.0, self._pending_tokens[t.lane] - t.cost
+        )
+        self._publish_depths()
+        # Virtual time advances to the served request's start tag —
+        # the standard start-time-fair-queuing clock.
+        self._vtime = max(self._vtime, t.vstart)
+
+    def note_first_token(self, t: Ticket, ttft_s: float) -> None:
+        """First token observed at the gateway: the request left the
+        admission queue — update lane depth, the WFQ clock, and the
+        TTFT estimator."""
+        with self._lock:
+            self._retire_locked(t)
+            self._est.observe(ttft_s, t.prompt_tokens, t.backlog_tokens)
+            self.metrics.observe(
+                "sched_queue_wait",
+                self._est.queue_wait(ttft_s, t.prompt_tokens),
+            )
+
+    def note_finished(self, t: Ticket) -> None:
+        """Terminal event for the request (stream closed, cancelled,
+        errored). Settles lane accounting for requests that died before
+        their first token."""
+        with self._lock:
+            self._retire_locked(t)
+            t.closed = True
+
+    def reset_estimator(self) -> None:
+        """Forget the learned latency model. Benchmarks call this after
+        their warm-up pass: warm-up TTFTs include one-off XLA compiles,
+        which would inflate the per-token rate and shed real traffic."""
+        with self._lock:
+            self._est = LatencyEstimator(alpha=self.cfg.ema_alpha)
+
+    # -- observability -----------------------------------------------------
+
+    def lane_depths(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._depth)

@@ -1,0 +1,82 @@
+"""Tenant identity and per-tenant token-bucket rate limiting.
+
+Identity is deliberately cheap: the API key (``Authorization: Bearer``
+or ``x-api-key`` header) when one is sent, else the OpenAI ``user``
+field, else the configured default tenant. Keys are sanitized into a
+metric-safe slug so per-tenant counters can ride ``/metrics`` without a
+cardinality explosion from arbitrary bytes.
+
+The bucket meters TOKEN cost (prompt tokens + max_tokens), not request
+count — a tenant flooding 2k-token prompts drains its bucket ~100x
+faster than one sending chat turns, which is the whole point.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Mapping, Optional
+
+_SLUG = re.compile(r"[^a-z0-9_]+")
+_SLUG_MAX = 48
+
+
+def _slug(raw: str) -> str:
+    s = _SLUG.sub("_", raw.strip().lower()).strip("_")
+    return (s or "anon")[:_SLUG_MAX]
+
+
+def resolve_tenant(
+    headers: Optional[Mapping[str, str]],
+    user: Optional[str],
+    default_tenant: str,
+) -> str:
+    """Map a request to its tenant slug. ``headers`` keys are expected
+    lowercased (the gateway parses them that way)."""
+    headers = headers or {}
+    auth = headers.get("authorization", "")
+    if auth.lower().startswith("bearer "):
+        key = auth[len("bearer "):].strip()
+        if key:
+            return _slug(key)
+    api_key = headers.get("x-api-key", "").strip()
+    if api_key:
+        return _slug(api_key)
+    if user:
+        return _slug(user)
+    return _slug(default_tenant)
+
+
+class TokenBucket:
+    """Classic token bucket over continuous time.
+
+    Not thread-safe by itself — the owning :class:`.scheduler.Scheduler`
+    serializes access under its lock. ``now`` is injected everywhere so
+    the refill arithmetic is exactly testable.
+    """
+
+    def __init__(self, rate_per_s: float, burst: float):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst) if burst > 0 else 2.0 * self.rate
+        self.level = self.burst  # start full: first burst is free
+        self._t: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self._t is None:
+            self._t = now
+        elif now > self._t:
+            self.level = min(self.burst, self.level + (now - self._t) * self.rate)
+            self._t = now
+
+    def try_take(self, cost: float, now: float) -> Optional[float]:
+        """Take ``cost`` tokens. Returns ``None`` on success, else the
+        seconds until the bucket holds ``cost`` — the request's actual
+        ``Retry-After``, not a constant. A cost above the burst capacity
+        can never pass; the wait still prices the shortfall honestly so
+        the client backs off proportionally."""
+        if self.rate <= 0:
+            return None  # rate limiting disabled
+        self._refill(now)
+        if self.level >= cost:
+            self.level -= cost
+            return None
+        return (cost - self.level) / self.rate

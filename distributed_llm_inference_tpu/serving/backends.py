@@ -18,8 +18,17 @@ One protocol, two implementations:
   tokens by sequence index so the client sees each token exactly once.
 
 Both expose the same surface the server consumes: ``start(loop)``,
-``submit(prompt, options, deadline) -> Handle``, ``cancel(handle)``,
-``active_sessions()``, ``queue_depth()``, ``stop()``, ``.metrics``.
+``submit(prompt, options, deadline, ticket=None) -> Handle``,
+``cancel(handle)``, ``active_sessions()``, ``queue_depth()``,
+``stop()``, ``.metrics``, ``attach_scheduler(sched)``.
+
+Admission policy lives OUTSIDE the backends, in :mod:`..sched`: the
+gateway's :class:`~..sched.Scheduler` decides rate limits, lanes and
+shedding, stamps each accepted request with a :class:`~..sched.Ticket`,
+and backends just carry it — EngineBackend/DisaggBackend forward the
+ticket's sort key into the engine's admission-order hook; the routing
+backends share the scheduler's placement rule
+(:mod:`..sched.placement`) for the prefix-locality-vs-load choice.
 """
 
 from __future__ import annotations
@@ -33,8 +42,9 @@ import time
 import uuid
 from typing import Dict, List, Optional, Sequence
 
-from ..config import DisaggConfig, PrefixConfig
+from ..config import DisaggConfig, PrefixConfig, SchedConfig
 from ..engine.sampling import SamplingOptions
+from ..sched.placement import choose_decode_node, prefix_worth_detour
 from ..utils.metrics import Metrics
 
 logger = logging.getLogger("distributed_llm_inference_tpu")
@@ -63,6 +73,10 @@ class Handle:
     queue: "asyncio.Queue[TokenEvent]"
     # ClientBackend's cancel signal (EngineBackend cancels via the engine).
     stop: Optional[threading.Event] = None
+    # The admission scheduler's stamp for this request (sched.Ticket);
+    # the gateway hands it back to the scheduler at first token / finish
+    # for lane-depth and estimator accounting. None = scheduler off.
+    ticket: Optional[object] = None
 
 
 class Backend:
@@ -78,8 +92,15 @@ class Backend:
         prompt: Sequence[int],
         options: SamplingOptions,
         deadline: Optional[float],
+        ticket=None,
     ) -> Handle:
         raise NotImplementedError
+
+    def attach_scheduler(self, sched) -> None:
+        """Install the gateway's admission scheduler. Backends with a
+        local engine wire its admission-order hook; the rest carry
+        tickets for accounting only (their admission queue lives
+        downstream, already gated by the scheduler at the gateway)."""
 
     def cancel(self, handle: Handle) -> None:
         raise NotImplementedError
@@ -178,12 +199,20 @@ class EngineBackend(Backend):
                 except RuntimeError:
                     pass  # loop already closed (server exited mid-tick)
 
-    def submit(self, prompt, options, deadline) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
         with self._hlock:
-            gid = self.engine.submit(prompt, options, deadline=deadline)
-            h = Handle(gen_id=gid, queue=asyncio.Queue())
+            gid = self.engine.submit(
+                prompt, options, deadline=deadline,
+                sched_key=ticket.sort_key if ticket is not None else None,
+            )
+            h = Handle(gen_id=gid, queue=asyncio.Queue(), ticket=ticket)
             self._handles[gid] = h
         return h
+
+    def attach_scheduler(self, sched) -> None:
+        # The engine's admission hook consumes the scheduler's ordering
+        # each tick instead of FIFO-popping the waiting queue.
+        self.engine.set_admission_order(sched.order_sessions)
 
     def cancel(self, handle: Handle) -> None:
         # The scheduler reaps at the next tick and emits the terminal
@@ -244,21 +273,26 @@ class DisaggBackend(EngineBackend):
         disagg_cfg: Optional[DisaggConfig] = None,
         idle_sleep_s: float = 0.002,
         prefix_cfg: Optional[PrefixConfig] = None,
+        sched_cfg: Optional[SchedConfig] = None,
     ):
         super().__init__(engine, idle_sleep_s=idle_sleep_s)
         self.relay_host, self.relay_port = relay_host, relay_port
         self.dcfg = disagg_cfg or DisaggConfig()
         self.pcfg = prefix_cfg or PrefixConfig()
+        # None = scheduler off: prefix routing keeps its legacy
+        # load-blind semantics (a floor-clearing match wins outright).
+        self.kcfg = sched_cfg
         self._tlock = threading.Lock()
         self._transfers: Dict[str, threading.Thread] = {}
 
-    def submit(self, prompt, options, deadline) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
         # The engine gen_id doesn't exist until the KV lands; hand the
         # server a provisional handle and rebind it at admission. ``stop``
         # doubles as the cancel signal for the transfer window, when the
         # engine doesn't know the session yet.
         key = f"disagg-{uuid.uuid4().hex[:12]}"
-        h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event())
+        h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event(),
+                   ticket=ticket)
         t = threading.Thread(
             target=self._run_disagg,
             args=(h, key, list(prompt), options, deadline),
@@ -288,10 +322,15 @@ class DisaggBackend(EngineBackend):
 
     def _prefer_local(self, prompt) -> bool:
         """Does the local decode engine hold enough cached prefix of
-        ``prompt`` that skipping the remote prefill hop wins? Threshold:
-        at least one full page, raised by ``PrefixConfig.min_shared_tokens``.
-        Probe failures just mean no preference — routing must never add a
-        failure mode."""
+        ``prompt`` that skipping the remote prefill hop wins? Two gates:
+        the match must clear the page/`min_shared_tokens` floor, and —
+        only when the scheduler is on — the shared placement rule
+        (sched/placement.py) must price the reuse above the local
+        engine's current contention, so a hot decode engine stops
+        pulling prefills onto itself no matter how long the match. With
+        the scheduler off the floor alone decides (legacy behavior).
+        Probe failures just mean no preference — routing must never add
+        a failure mode."""
         if not self.pcfg.route_by_prefix:
             return False
         try:
@@ -299,7 +338,13 @@ class DisaggBackend(EngineBackend):
         except Exception:  # noqa: BLE001 - probe only, degrade to no-pref
             return False
         ps = getattr(self.engine.ccfg, "page_size", 1)
-        return got >= max(self.pcfg.min_shared_tokens, ps)
+        if got < max(self.pcfg.min_shared_tokens, ps):
+            return False
+        kcfg = getattr(self, "kcfg", None)
+        if kcfg is None:
+            return True
+        local_load = self.engine.active_sessions() + self.engine.queue_depth()
+        return prefix_worth_detour(got, local_load, 0.0, kcfg)
 
     def _pick_prefill_node(self) -> Optional[dict]:
         from ..distributed.directory import DirectoryClient
@@ -394,7 +439,11 @@ class DisaggBackend(EngineBackend):
                     self.metrics.counter("routed_by_prefix")
                     with self._hlock:
                         gid = self.engine.submit(
-                            prompt, options, deadline=deadline
+                            prompt, options, deadline=deadline,
+                            sched_key=(
+                                h.ticket.sort_key
+                                if h.ticket is not None else None
+                            ),
                         )
                         h.gen_id = gid
                         self._handles[gid] = h
@@ -444,7 +493,11 @@ class DisaggBackend(EngineBackend):
                     try:
                         with self._hlock:
                             gid = self.engine.submit(
-                                prompt, options, deadline=deadline
+                                prompt, options, deadline=deadline,
+                                sched_key=(
+                                    h.ticket.sort_key
+                                    if h.ticket is not None else None
+                                ),
                             )
                             h.gen_id = gid
                             self._handles[gid] = h
@@ -515,7 +568,7 @@ class ClientBackend(Backend):
             )
             self._collector.start()
 
-    def submit(self, prompt, options, deadline) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
         if self._stop_evt.is_set():
             # The server drains before backend.stop(), so this only fires
             # on a race — but a request enqueued after stop would never get
@@ -524,7 +577,8 @@ class ClientBackend(Backend):
         with self._tlock:
             self._ids += 1
             gid = f"req-{self._ids}"
-        h = Handle(gen_id=gid, queue=asyncio.Queue(), stop=threading.Event())
+        h = Handle(gen_id=gid, queue=asyncio.Queue(), stop=threading.Event(),
+                   ticket=ticket)
         if self._pending is not None:
             # Not added to _active yet: a queued request is counted by
             # queue_depth() alone until the collector claims it (admission
@@ -779,10 +833,14 @@ class FleetBackend(Backend):
         metrics: Optional[Metrics] = None,
         pool_wait_s: float = 2.0,
         prefix_cfg: Optional[PrefixConfig] = None,
+        sched_cfg: Optional[SchedConfig] = None,
     ):
         self.relay_host, self.relay_port = relay_host, relay_port
         self.dcfg = disagg_cfg or DisaggConfig()
         self.pcfg = prefix_cfg or PrefixConfig()
+        # None = scheduler off: prefix routing keeps its legacy
+        # load-blind semantics (the advertised holder wins outright).
+        self.kcfg = sched_cfg
         self.metrics = metrics or Metrics()
         self._dead_after = self.dcfg.dead_after_s or self.dcfg.lease_ttl_s
         self._pool_wait_s = pool_wait_s
@@ -798,11 +856,12 @@ class FleetBackend(Backend):
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
 
-    def submit(self, prompt, options, deadline) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
         if self._stop_evt.is_set():
             raise RuntimeError("backend is stopping")
         key = f"fleet-{uuid.uuid4().hex[:12]}"
-        h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event())
+        h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event(),
+                   ticket=ticket)
         t = threading.Thread(
             target=self._run_fleet,
             args=(h, key, list(prompt), options, deadline),
@@ -848,10 +907,15 @@ class FleetBackend(Backend):
 
     def _pick_prefix(self, directory, prompt, dead_ids) -> Optional[dict]:
         """The live decode node holding the longest advertised prefix of
-        ``prompt`` (``None`` when nothing useful matches — the caller falls
-        back to least-loaded). A directory blip or a matched-but-gone node
-        also yields ``None``: prefix routing is an optimization and must
-        never add a failure mode to placement."""
+        ``prompt``. With the scheduler on, the shared placement rule
+        (sched/placement.py) must also price its match above its load
+        disadvantage — a loaded holder loses to an idle node once the
+        queueing it would add outweighs the prefill the match saves, so
+        routing stops contradicting the scheduler it feeds; scheduler
+        off keeps the legacy load-blind pick. ``None`` = no useful match
+        (the caller falls back to least-loaded). A directory blip or a
+        matched-but-gone node also yields ``None``: prefix routing is an
+        optimization and must never add a failure mode to placement."""
         if not self.pcfg.route_by_prefix:
             return None
         try:
@@ -859,11 +923,21 @@ class FleetBackend(Backend):
             if (nid is None or nid in dead_ids
                     or tokens < max(self.pcfg.min_shared_tokens, 1)):
                 return None
-            for n in directory.alive():
-                if (n.get("node_id") == nid and n.get("role") == "decode"
-                        and not n.get("pending")):
-                    self.metrics.counter("routed_by_prefix")
-                    return n
+            nodes = [
+                n for n in directory.alive()
+                if n.get("role") == "decode" and not n.get("pending")
+                and n.get("node_id") not in dead_ids
+            ]
+            if self.kcfg is None:
+                best = next(
+                    (n for n in nodes if n.get("node_id") == nid), None)
+            else:
+                best = choose_decode_node(nodes, nid, tokens, self.kcfg)
+                if best is not None and best.get("node_id") != nid:
+                    best = None
+            if best is not None:
+                self.metrics.counter("routed_by_prefix")
+                return best
         except Exception:  # noqa: BLE001 - probe only, fall back
             pass
         return None

@@ -7,8 +7,10 @@ when a tokenizer is present plus a ``token_ids`` extension field either
 way, so tokenizer-less deployments still stream usable output.
 
 Gateway extensions beyond the OpenAI schema: ``timeout_s`` (per-request
-deadline override, capped by ``ServingConfig.max_timeout_s``) and
-``top_k``.
+deadline override, capped by ``ServingConfig.max_timeout_s``),
+``top_k``, and ``lane`` (``"interactive"`` | ``"batch"`` — the admission
+scheduler's priority lane; see ``sched/``). The OpenAI ``user`` field is
+parsed as a tenant identity fallback when no API key header is sent.
 """
 
 from __future__ import annotations
@@ -33,6 +35,10 @@ class CompletionRequest:
     timeout_s: Optional[float]
     options: SamplingOptions
     echo_text: Optional[str]  # original string prompt, if one was sent
+    # Tenant identity fallback (OpenAI "user" field) and admission lane
+    # for the scheduler; None when the request names neither.
+    user: Optional[str] = None
+    lane: Optional[str] = None
 
 
 def _require_number(body: Dict[str, Any], key: str, default, lo, hi):
@@ -94,6 +100,14 @@ def parse_completion_request(
     eos = body.get("eos_token_id", -1)
     if not isinstance(eos, int) or isinstance(eos, bool):
         raise BadRequest("'eos_token_id' must be an integer")
+    user = body.get("user")
+    if user is not None and (
+        not isinstance(user, str) or not user or len(user) > 256
+    ):
+        raise BadRequest("'user' must be a non-empty string (<= 256 chars)")
+    lane = body.get("lane")
+    if lane is not None and lane not in ("interactive", "batch"):
+        raise BadRequest("'lane' must be 'interactive' or 'batch'")
 
     opts = SamplingOptions(
         temperature=temperature,
@@ -109,6 +123,8 @@ def parse_completion_request(
         timeout_s=timeout_s,
         options=opts,
         echo_text=echo_text,
+        user=user,
+        lane=lane,
     )
 
 

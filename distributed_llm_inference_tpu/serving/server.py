@@ -29,7 +29,8 @@ import time
 import uuid
 from typing import Optional
 
-from ..config import ServingConfig
+from ..config import SchedConfig, ServingConfig
+from ..sched import Scheduler
 from .backends import Backend, Handle, TokenEvent
 from .breaker import CircuitBreaker
 from .protocol import (
@@ -45,6 +46,15 @@ from .sse import SSE_DONE, sse_event, sse_headers
 # backend's own deadline reap (which emits the terminal event with the
 # real finish_reason) normally wins the race.
 _DEADLINE_GRACE_S = 0.5
+
+
+def _retry_after_line(seconds: float) -> str:
+    """A Retry-After header line; sub-second waits keep their fraction
+    (clients in this repo's tests parse float) while >= 1 s rounds to
+    the integer form proxies expect."""
+    if seconds >= 1:
+        return f"Retry-After: {seconds:.0f}\r\n"
+    return f"Retry-After: {max(seconds, 0.001):.3f}\r\n"
 
 
 def _response(status: str, body: bytes, content_type: str = "application/json",
@@ -68,10 +78,17 @@ class ApiServer:
     """
 
     def __init__(self, backend: Backend, scfg: Optional[ServingConfig] = None,
-                 tokenizer=None):
+                 tokenizer=None, sched_cfg: Optional[SchedConfig] = None):
         self.backend = backend
         self.scfg = scfg or ServingConfig()
         self.tokenizer = tokenizer
+        # Multi-tenant admission scheduler (sched/): tenant rate limits,
+        # weighted-fair lanes the engine honors at admission, and
+        # deadline-aware shedding. None = legacy FIFO admission.
+        self.sched: Optional[Scheduler] = None
+        if sched_cfg is not None:
+            self.sched = Scheduler(sched_cfg, backend.metrics)
+            backend.attach_scheduler(self.sched)
         # The breaker shares the backend's Metrics, so its state gauge and
         # transition counters ride the same /metrics endpoint.
         self.breaker = CircuitBreaker(
@@ -232,7 +249,7 @@ class ApiServer:
         elif method == "GET" and path == "/metrics":
             await self._metrics(writer)
         elif method == "POST" and path == "/v1/completions":
-            await self._completions(writer, body)
+            await self._completions(writer, body, headers)
         elif path in ("/healthz", "/metrics", "/v1/completions"):
             writer.write(_response(
                 "405 Method Not Allowed",
@@ -248,12 +265,17 @@ class ApiServer:
             await writer.drain()
 
     async def _healthz(self, writer) -> None:
-        body = json.dumps({
+        doc = {
             "status": "draining" if self._draining else "ok",
             "active_sessions": self.backend.active_sessions(),
             "queue_depth": self.backend.queue_depth(),
             "breaker": self.breaker.state,
-        }).encode()
+        }
+        if self.sched is not None:
+            # Per-lane pending depths (admitted, pre-first-token) — the
+            # load balancer's view of interactive vs batch pressure.
+            doc["lanes"] = self.sched.lane_depths()
+        body = json.dumps(doc).encode()
         writer.write(_response("200 OK", body))
         await writer.drain()
 
@@ -278,7 +300,26 @@ class ApiServer:
 
     # -- completions ----------------------------------------------------------
 
-    async def _completions(self, writer, body: bytes) -> None:
+    async def _reject_429(self, writer, message: str, code: str,
+                          retry_after_s: Optional[float]) -> None:
+        """One 429 with its reason code (``rate_limit`` | ``queue_full``
+        | ``shed``) and, when the policy computed one, a real
+        Retry-After. ``http_429`` counts every shed path; the
+        ``sched_*`` reason counters (bumped at the decision site) split
+        them."""
+        self.backend.metrics.counter("http_429")
+        extra = ""
+        if retry_after_s is not None:
+            extra = _retry_after_line(retry_after_s)
+        writer.write(_response(
+            "429 Too Many Requests",
+            error_body(message, "rate_limit_error", code),
+            extra=extra,
+        ))
+        await writer.drain()
+
+    async def _completions(self, writer, body: bytes,
+                           headers=None) -> None:
         self.backend.metrics.counter("http_requests")
         if self._draining:
             writer.write(_response(
@@ -300,16 +341,13 @@ class ApiServer:
             await writer.drain()
             return
         if self._inflight >= self.scfg.max_queue_depth:
-            self.backend.metrics.counter("http_429")
-            writer.write(_response(
-                "429 Too Many Requests",
-                error_body("server is at capacity, retry later",
-                           "rate_limit_error", "queue_full"),
-                extra=f"Retry-After: {self.scfg.retry_after_s:.0f}\r\n"
-                if self.scfg.retry_after_s >= 1
-                else f"Retry-After: {self.scfg.retry_after_s}\r\n",
-            ))
-            await writer.drain()
+            retry = self.scfg.retry_after_s
+            if self.sched is not None:
+                self.backend.metrics.counter("sched_reject_queue_full")
+            await self._reject_429(
+                writer, "server is at capacity, retry later", "queue_full",
+                retry,
+            )
             return
         try:
             req = parse_completion_request(body, self.scfg, self.tokenizer)
@@ -328,8 +366,43 @@ class ApiServer:
         )
         submit_t = time.monotonic()
         deadline = submit_t + timeout_s
+        ticket = None
+        if self.sched is not None:
+            # Scheduler-gated admission: every rejection here happens
+            # BEFORE backend.submit — a rate-limited or shed request
+            # never dispatches prefill work.
+            tenant = self.sched.resolve(headers, req.user)
+            lane = self.sched.lane_of(req.lane)
+            decision = self.sched.admit(
+                tenant, lane, len(req.prompt), req.max_tokens, deadline,
+                now=submit_t,
+            )
+            if not decision.ok:
+                if decision.reason == "rate_limit":
+                    msg = f"tenant {tenant!r} is over its token rate limit"
+                elif decision.reason == "shed":
+                    msg = ("request shed at admission: its estimated "
+                           "queue-wait + prefill time exceeds its deadline")
+                else:
+                    msg = "admission queue is full, retry later"
+                await self._reject_429(
+                    writer, msg, decision.reason,
+                    decision.retry_after_s
+                    if decision.retry_after_s is not None
+                    else (None if decision.reason == "shed"
+                          else self.scfg.retry_after_s),
+                )
+                return
+            ticket = decision.ticket
         self._inflight += 1
-        handle = self.backend.submit(req.prompt, req.options, deadline)
+        # Scheduler off → legacy positional call, so backends that predate
+        # the ticket kwarg (including test stubs) keep working unchanged.
+        if ticket is not None:
+            handle = self.backend.submit(
+                req.prompt, req.options, deadline, ticket=ticket
+            )
+        else:
+            handle = self.backend.submit(req.prompt, req.options, deadline)
         self._handles.add(handle)
         req_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -346,6 +419,10 @@ class ApiServer:
         finally:
             self._handles.discard(handle)
             self._inflight -= 1
+            if self.sched is not None and ticket is not None:
+                # Retire the ticket even when the stream died before its
+                # first token — lane depths must not leak.
+                self.sched.note_finished(ticket)
             # Feed the breaker from the real outcome: only backend errors
             # count as failures (timeouts/cancels/deadlines are request
             # policy, not backend health; reason None means the handler
@@ -369,7 +446,13 @@ class ApiServer:
             self.backend.cancel(handle)
             return None
         if first and ev.token >= 0:
-            self.backend.metrics.observe("ttft", time.monotonic() - submit_t)
+            ttft = time.monotonic() - submit_t
+            self.backend.metrics.observe("ttft", ttft)
+            if self.sched is not None and handle.ticket is not None:
+                # The scheduler's latency model learns from every
+                # observed TTFT (prefill cost + queue wait) — this is
+                # what deadline shedding extrapolates from.
+                self.sched.note_first_token(handle.ticket, ttft)
         return ev
 
     async def _json_completion(self, writer, req, handle, deadline,

@@ -100,6 +100,14 @@ METRICS = {
     "engine_ttft": ("summary", "Engine-side TTFT (sync admission)"),
     "engine_ttft_decode": ("summary", "Engine-side TTFT (overlap admission)"),
     "engine_ttft_prefill": ("summary", "Engine-side TTFT (disagg prefill)"),
+    # multi-tenant admission scheduler (sched/)
+    "sched_admitted": ("counter", "Tickets admitted by the scheduler"),
+    "sched_tenant_admit_*": ("counter", "Admitted tickets by tenant"),
+    "sched_reject_rate_limit": ("counter", "429s from a tenant token bucket"),
+    "sched_reject_queue_full": ("counter", "429s from lane/gateway depth caps"),
+    "sched_shed_early": ("counter", "Requests shed pre-prefill by deadline"),
+    "sched_lane_depth_*": ("gauge", "Pending tickets per admission lane"),
+    "sched_queue_wait": ("summary", "Ticket admission to first token"),
     # circuit breaker
     "breaker_state": ("gauge", "0 closed / 1 open / 2 half-open"),
     "breaker_*_transitions": ("counter", "Breaker transitions into a state"),

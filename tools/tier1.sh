@@ -46,6 +46,13 @@
 # flood back-pressure) are deliberately NOT marked 'slow': they are the
 # correctness gate for the deferred-fetch admission path and must run in
 # every tier-1 pass (~45 s of the budget on CPU).
+# The multi-tenant scheduler contract tests (tests/test_sched.py:
+# token-bucket refill math, weighted-fair ordering + lane interleave,
+# deadline shedding before prefill dispatch, byte-exact stream parity
+# with admission reordering on/off, and the 2-tenant starvation
+# regression over HTTP) are tier-1 and deliberately NOT marked 'slow':
+# they are the correctness gate for scheduler-ordered admission — the
+# byte-exactness cases are what licenses turning `--sched` on at all.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
