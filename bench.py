@@ -1817,11 +1817,35 @@ def _prefix_phase() -> dict:
     return out
 
 
-def _traffic_phase() -> dict:
+# Arrival shape for `--phase traffic`, settable via `--arrival` (see main()).
+_ARRIVAL = "poisson"
+
+
+def _rate_envelope(shape: str, t: float, window_s: float) -> float:
+    """Arrival-rate multiplier at time ``t`` for the traffic phases'
+    non-homogeneous Poisson processes. ``poisson`` is the flat legacy
+    process; ``bursty`` alternates 1 s spikes at 3x the base rate with
+    troughs at 0.6x (mean ~1.4x — the shape an elastic fleet must absorb
+    without provisioning for the spike full-time); ``diurnal`` sweeps a
+    full sinusoid over the window (0.2x..1.8x), the compressed
+    day/night cycle."""
+    import math
+
+    if shape == "bursty":
+        return 3.0 if (t % 3.0) < 1.0 else 0.6
+    if shape == "diurnal":
+        return 1.0 + 0.8 * math.sin(2.0 * math.pi * t / max(window_s, 1e-9))
+    return 1.0
+
+
+def _traffic_phase(arrival: str = "poisson") -> dict:
     """Open-loop multi-tenant traffic harness (`--phase traffic`): a
     Poisson arrival process per tenant fired at a real HTTP gateway —
     arrivals never wait for completions, so queueing shows up as TTFT
     tail growth instead of being absorbed by a closed loop's back-off.
+    ``--arrival bursty|diurnal`` reshapes both tenants' processes with
+    the seeded rate envelope (``_rate_envelope``) while keeping the
+    schedule deterministic per seed.
     Two adversarial tenants: "chat" (interactive lane, multi-turn
     requests sharing a system prefix, modest max_tokens) and "scraper"
     (batch lane, heavy-tailed prompt lengths, higher rate). Three runs
@@ -1932,12 +1956,16 @@ def _traffic_phase() -> dict:
             rec["error"] = repr(e)[:80]
 
     def make_workload(seed, include_batch):
-        """Deterministic open-loop schedule: [(arrival_s, kwargs)]."""
+        """Deterministic open-loop schedule: [(arrival_s, kwargs)].
+        Non-homogeneous Poisson via rate-modulated gaps: each gap is
+        sampled at the envelope-scaled rate current at that moment, so
+        the same seed + shape always yields the same schedule."""
         rng = random.Random(seed)
         work = []
         t = 0.0
-        while True:  # interactive "chat": ~3 req/s, shared-prefix turns
-            t += rng.expovariate(3.0)
+        while True:  # interactive "chat": ~3 req/s base, shared prefix
+            t += rng.expovariate(
+                3.0 * max(_rate_envelope(arrival, t, WINDOW_S), 0.05))
             if t >= WINDOW_S:
                 break
             turn = [rng.randrange(2, 98) for _ in range(rng.randrange(8, 25))]
@@ -1947,7 +1975,8 @@ def _traffic_phase() -> dict:
         if include_batch:
             t = 0.0
             while True:  # batch "scraper": ~4 req/s, heavy-tailed lengths
-                t += rng.expovariate(4.0)
+                t += rng.expovariate(
+                    4.0 * max(_rate_envelope(arrival, t, WINDOW_S), 0.05))
                 if t >= WINDOW_S:
                     break
                 if rng.random() < 0.2:  # the heavy tail
@@ -2059,6 +2088,10 @@ def _traffic_phase() -> dict:
     sched_p99 = sched["chat"]["ttft_ms_p99"] or 0.0
     return {
         "scope": "cpu-localhost", "window_s": WINDOW_S,
+        "arrival": arrival,
+        # One gateway+engine for the whole window: the node-count
+        # integral a fleet run (`--phase elastic`) is compared against.
+        "node_seconds": WINDOW_S,
         "slo_ttft_ms": round(slo_s * 1e3, 1),
         "solo_interactive": solo,
         "fifo": fifo, "sched": sched,
@@ -2067,6 +2100,267 @@ def _traffic_phase() -> dict:
                     "jain_fairness": ">=0.8",
                     "sheds_pre_prefill": "engine submits < gateway "
                                          "requests when shed_early > 0"},
+    }
+
+
+def _elastic_phase() -> dict:
+    """Elastic vs statically over-provisioned decode fleet under bursty
+    open-loop traffic (`--phase elastic`): the same seeded bursty
+    workload (shared-prefix prompts, 1 s spikes at 3x the base rate) is
+    fired at a FleetBackend gateway twice — once over a static pool of
+    ``N_MAX`` decode nodes up for the whole window, once starting from
+    one node with the FleetController autoscaling between 1 and
+    ``N_MAX`` (warm standbys spawn on sustained load, drain-then-fence
+    on idle). Reports per-run goodput under an SLO derived from the
+    static run's TTFT p50, the node-count integral (node-seconds, the
+    provisioning cost), and the fleet/cost-model decision counters.
+    Acceptance target: elastic goodput within ~10% of static at a lower
+    node-count integral. Native-relay CPU phase, opt-in like traffic."""
+    import http.client
+    import random
+    import threading
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu":
+        return {"error": "backend already initialized non-cpu; run this "
+                         "phase in its own process",
+                "scope": "cpu-localhost"}
+    from distributed_llm_inference_tpu.config import (
+        CacheConfig, DisaggConfig, EngineConfig, FleetConfig, ModelConfig,
+        PrefixConfig, ServingConfig,
+    )
+    from distributed_llm_inference_tpu.disagg import DecodeNode
+    from distributed_llm_inference_tpu.distributed.directory import (
+        DirectoryClient, DirectoryService,
+    )
+    from distributed_llm_inference_tpu.distributed.relay import (
+        RelayServer, native_available,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+    from distributed_llm_inference_tpu.fleet import (
+        FleetController, live_decode_rows,
+    )
+    from distributed_llm_inference_tpu.models import llama as llama_mod
+    from distributed_llm_inference_tpu.serving import ApiServer, FleetBackend
+
+    if not native_available():
+        return {"error": "g++ unavailable to build the native relay",
+                "scope": "cpu-localhost"}
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+    )
+    params = llama_mod.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    WINDOW_S = 8.0
+    N_MAX = 3
+    SYS = [(i * 31) % 96 + 2 for i in range(24)]  # shared prompt prefix
+    # Generous lease: N engines decoding + open-loop request threads on
+    # one CPU starve 1 s heartbeats into false expiry, which reads as
+    # node churn rather than load.
+    DCFG = DisaggConfig(lease_ttl_s=3.0, checkpoint_interval_ticks=4,
+                        resume_max_attempts=4)
+    FCFG = FleetConfig(
+        drain_timeout_s=5.0, autoscale_interval_s=0.2, scale_out_load=1.5,
+        scale_in_load=0.3, scale_hold_s=0.6, min_nodes=1, max_nodes=N_MAX,
+        rebalance_interval_s=2.0, hot_load_factor=1.8,
+    )
+
+    def make_engine():
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch_size=2, prefill_buckets=(16, 32, 64),
+                         max_seq_len=96, dtype="float32"),
+            CacheConfig(kind="paged", page_size=8, num_pages=128,
+                        max_pages_per_session=10, prefix_caching=True),
+        )
+        # Warm standby: compile prefill + decode BEFORE the timed window
+        # for both runs (scale-out registers an already-warm engine).
+        eng.submit(list(SYS) + [3] * 8,
+                   SamplingOptions(max_new_tokens=2, temperature=0.0))
+        while eng.has_work():
+            eng.step()
+        eng.collect_finished()
+        return eng
+
+    def make_workload(seed):
+        rng = random.Random(seed)
+        work, t = [], 0.0
+        while True:  # single bursty tenant, ~1.5 req/s base rate
+            t += rng.expovariate(
+                1.5 * max(_rate_envelope("bursty", t, WINDOW_S), 0.05))
+            if t >= WINDOW_S:
+                break
+            tail = [rng.randrange(2, 98) for _ in range(rng.randrange(4, 13))]
+            work.append((t, SYS + tail))
+        return work
+
+    def _do_request(prompt, port, rec):
+        t0 = time.perf_counter()
+        rec.setdefault("status", 0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+            conn.request(
+                "POST", "/v1/completions",
+                json.dumps({"prompt": prompt, "max_tokens": 12,
+                            "stream": True, "timeout_s": 30.0}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            rec["status"] = resp.status
+            if resp.status != 200:
+                conn.close()
+                return
+            for raw in resp:
+                if not raw.startswith(b"data: "):
+                    continue
+                payload = raw[len(b"data: "):].strip()
+                if payload == b"[DONE]":
+                    break
+                doc = json.loads(payload)
+                if doc["choices"][0]["token_ids"]:
+                    rec.setdefault("ttft", time.perf_counter() - t0)
+                    rec["tokens"] = rec.get("tokens", 0) + 1
+            conn.close()
+        except Exception as e:  # noqa: BLE001 - failure = lost goodput
+            rec["error"] = repr(e)[:80]
+
+    def run_fleet(elastic, seed=4321):
+        with RelayServer() as relay:
+            with DirectoryService(relay.port, default_ttl=5.0):
+                standby = [make_engine() for _ in range(N_MAX)]
+                live, counter = {}, [0]
+
+                def spawn():
+                    if not standby:
+                        return
+                    nid = f"d{counter[0]}"
+                    counter[0] += 1
+                    live[nid] = DecodeNode(relay.port, standby.pop(),
+                                           node_id=nid, disagg_cfg=DCFG,
+                                           epoch=1)
+
+                def retire(nid):
+                    n = live.pop(nid, None)
+                    if n is not None:
+                        n.stop()
+
+                for _ in range(1 if elastic else N_MAX):
+                    spawn()
+                ctl = None
+                if elastic:
+                    ctl = FleetController(
+                        relay.port, fleet_cfg=FCFG, disagg_cfg=DCFG,
+                        spawn=spawn, retire=retire,
+                    )
+                    ctl.start()
+                backend = FleetBackend(relay.port, disagg_cfg=DCFG,
+                                       prefix_cfg=PrefixConfig(),
+                                       fleet_cfg=FCFG)
+                server = ApiServer(backend, ServingConfig(
+                    host="127.0.0.1", port=0, max_queue_depth=256))
+                server.start()
+                # Node-count integral: sample the routable pool at 10 Hz.
+                integral = [0.0]
+                stop_sampler = threading.Event()
+
+                def sample():
+                    d = DirectoryClient(relay.port)
+                    try:
+                        last = time.perf_counter()
+                        while not stop_sampler.wait(0.1):
+                            now = time.perf_counter()
+                            try:
+                                rows = live_decode_rows(d.alive())
+                            except Exception:  # noqa: BLE001
+                                rows = []
+                            integral[0] += (now - last) * len(rows)
+                            last = now
+                    finally:
+                        d.close()
+
+                sampler = threading.Thread(target=sample, daemon=True)
+                sampler.start()
+                try:
+                    work = make_workload(seed)
+                    recs = [dict() for _ in work]
+                    threads = []
+                    t0 = time.perf_counter()
+                    for (at, prompt), rec in zip(work, recs):
+                        delay = at - (time.perf_counter() - t0)
+                        if delay > 0:
+                            time.sleep(delay)  # open loop
+                        th = threading.Thread(target=_do_request,
+                                              args=(prompt, server.port, rec),
+                                              daemon=True)
+                        th.start()
+                        threads.append(th)
+                    for th in threads:
+                        th.join(timeout=60.0)
+                finally:
+                    stop_sampler.set()
+                    sampler.join(timeout=5.0)
+                    if ctl is not None:
+                        ctl.close()
+                    server.request_shutdown()
+                    server.join(timeout=60.0)
+                    for n in list(live.values()):
+                        n.stop()
+                snap = dict(backend.metrics.snapshot())
+                if ctl is not None:
+                    snap.update({f"ctl_{k}": v for k, v in
+                                 ctl.metrics.snapshot().items()})
+                return recs, integral[0], snap
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q / 100.0 * len(vals)))]
+
+    def summarize(recs, node_seconds, snap, slo_s):
+        ttfts = [r["ttft"] for r in recs if "ttft" in r]
+        good = sum(r.get("tokens", 0) for r in recs
+                   if r.get("ttft") is not None and r["ttft"] <= slo_s)
+        p99 = pct(ttfts, 99)
+        return {
+            "requests": len(recs),
+            "ok": sum(1 for r in recs if r.get("tokens")),
+            "ttft_ms_p50": round((pct(ttfts, 50) or 0.0) * 1e3, 1),
+            "ttft_ms_p99": round(p99 * 1e3, 1) if p99 else None,
+            "goodput_tok_s": round(good / WINDOW_S, 1),
+            "node_seconds": round(node_seconds, 1),
+            "decisions": {
+                "query_moved": int(snap.get("fleet_query_moved", 0)),
+                "pages_fetched": int(snap.get("fleet_pages_fetched", 0)),
+                "migrated": int(snap.get("fleet_migrated", 0)),
+                "routed_by_prefix": int(snap.get("routed_by_prefix", 0)),
+                "drained_sessions": int(
+                    snap.get("fleet_drained_sessions", 0)),
+                "scale_out": int(snap.get("ctl_fleet_scale_out", 0)),
+                "scale_in": int(snap.get("ctl_fleet_scale_in", 0)),
+            },
+        }
+
+    static_recs, static_ns, static_snap = run_fleet(elastic=False)
+    ttfts = [r["ttft"] for r in static_recs if "ttft" in r]
+    slo_s = max(0.25, 4.0 * (pct(ttfts, 50) or 0.0))
+    elastic_recs, elastic_ns, elastic_snap = run_fleet(elastic=True)
+
+    static = summarize(static_recs, static_ns, static_snap, slo_s)
+    elastic = summarize(elastic_recs, elastic_ns, elastic_snap, slo_s)
+    ratio = (elastic["goodput_tok_s"] / static["goodput_tok_s"]
+             if static["goodput_tok_s"] else None)
+    return {
+        "scope": "cpu-localhost", "window_s": WINDOW_S,
+        "arrival": "bursty", "n_max": N_MAX,
+        "slo_ttft_ms": round(slo_s * 1e3, 1),
+        "static": static, "elastic": elastic,
+        "goodput_vs_static": round(ratio, 3) if ratio is not None else None,
+        "node_seconds_saved": round(static_ns - elastic_ns, 1),
+        "targets": {"goodput_vs_static": ">=0.9",
+                    "node_seconds": "elastic < static"},
     }
 
 
@@ -2080,7 +2374,9 @@ def run_phase(name: str) -> dict:
     if name == "prefix":
         return _prefix_phase()
     if name == "traffic":
-        return _traffic_phase()
+        return _traffic_phase(_ARRIVAL)
+    if name == "elastic":
+        return _elastic_phase()
     if name == "prefill":
         return _prefill_phase()
     on_tpu = jax.default_backend() == "tpu"
@@ -2184,6 +2480,9 @@ def main():
     import sys
 
     if "--phase" in sys.argv:
+        if "--arrival" in sys.argv:  # poisson | bursty | diurnal
+            global _ARRIVAL
+            _ARRIVAL = sys.argv[sys.argv.index("--arrival") + 1]
         print(json.dumps(run_phase(sys.argv[sys.argv.index("--phase") + 1])))
         return
 

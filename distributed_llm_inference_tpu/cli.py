@@ -520,6 +520,37 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """One-shot elastic-fleet operations against a running relay +
+    decode pool: inspect the pool, drain-then-fence one node (its
+    in-flight streams live-migrate off with zero token loss), or run a
+    single hot-node rebalance pass."""
+    from .config import FleetConfig
+    from .fleet import FleetController
+
+    host, port = _parse_relay(args.relay)
+    ctl = FleetController(
+        port, host,
+        fleet_cfg=FleetConfig(drain_timeout_s=args.drain_timeout),
+    )
+    try:
+        if args.action == "status":
+            print(json.dumps(ctl.status(), indent=2))
+        elif args.action == "drain":
+            if not args.node:
+                print("fleet drain: a node id is required", file=sys.stderr)
+                return 2
+            print(json.dumps(ctl.drain(args.node)))
+        else:  # rebalance
+            print(json.dumps({"migrations": ctl.rebalance_once()}))
+    except LookupError as e:
+        print(f"fleet {args.action}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        ctl.close()
+    return 0
+
+
 def cmd_info(args) -> int:
     from .models import registry
     from .utils import checkpoint
@@ -810,6 +841,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "refuses reconnects, so heartbeats stop too and "
                         "the node's directory lease expires)")
     c.set_defaults(fn=cmd_chaos)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="elastic decode-pool control: status / drain (live-migrate "
+             "a node's sessions off, then fence it) / rebalance "
+             "(migrate sessions off hot nodes)",
+    )
+    fl.add_argument("action", choices=("status", "drain", "rebalance"))
+    fl.add_argument("node", nargs="?", default=None,
+                    help="node id to drain (drain action only)")
+    fl.add_argument("--relay", required=True, help="host:port of the relay")
+    fl.add_argument("--drain-timeout", type=float, default=15.0,
+                    help="seconds to wait for the drained node's load to "
+                         "reach zero before fencing anyway (stragglers "
+                         "re-home via crash recovery, still exactly-once)")
+    fl.set_defaults(fn=cmd_fleet)
 
     i = sub.add_parser("info", help="inspect a checkpoint")
     i.add_argument("--model", required=True)

@@ -440,3 +440,63 @@ class DisaggConfig:
     # (lease expired) before it is declared dead. 0 derives the window
     # from ``lease_ttl_s``.
     dead_after_s: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Elastic fleet policy (``fleet/``): drain, rebalance, autoscale and
+    the bytes-vs-latency cost model behind the query-move / page-ship /
+    migrate placement decision.
+
+    The cost-model fields are *seeds*: ``wire_bytes_per_s`` and
+    ``prefill_s_per_token`` are refined online from measured transfers
+    (EMA with ``cost_ema_alpha``); the rest stay as configured.
+    """
+
+    # --- drain -----------------------------------------------------------
+    # How long ``FleetController.drain`` waits for the node's directory
+    # load to reach zero (all in-flight sessions handed off) before
+    # fencing anyway. Fencing a half-drained node is safe — the shipped
+    # checkpoints re-home the stragglers through crash recovery — but
+    # waiting lets the cheap path finish first.
+    drain_timeout_s: float = 15.0
+    # --- rebalance -------------------------------------------------------
+    # Period of the controller's hot-node scan (seconds).
+    rebalance_interval_s: float = 5.0
+    # A decode node is "hot" when its heartbeat load exceeds this factor
+    # times the pool's mean load (needs >= 2 live nodes to act).
+    hot_load_factor: float = 2.0
+    # Max sessions asked to migrate off a hot node per rebalance pass
+    # (the node picks its longest-running routes first).
+    rebalance_max_sessions: int = 2
+    # --- autoscale -------------------------------------------------------
+    # Period of the scale in/out evaluation (seconds).
+    autoscale_interval_s: float = 1.0
+    # Scale out when mean load per live decode node stays above this for
+    # ``scale_hold_s``; scale in when it stays below ``scale_in_load``.
+    scale_out_load: float = 3.0
+    scale_in_load: float = 0.5
+    scale_hold_s: float = 3.0
+    # Pool size bounds the autoscaler respects (scale-in never drains
+    # below ``min_nodes``; scale-out never spawns past ``max_nodes``).
+    min_nodes: int = 1
+    max_nodes: int = 8
+    # --- cost model ------------------------------------------------------
+    # Estimated KV bytes per cached prefix token (all layers, stored
+    # form). Sizes the page-ship transfer in the cost comparison.
+    kv_bytes_per_token: float = 4096.0
+    # Seed estimate of node-to-node relay throughput; refined online
+    # from measured page-ship round trips.
+    wire_bytes_per_s: float = 1.0e9
+    # Queueing penalty: seconds of extra latency per unit of directory
+    # load difference when the query moves to the (busier) prefix holder.
+    queue_s_per_load: float = 0.05
+    # Seed estimate of recompute cost when neither the query nor the
+    # pages move (plain migration: the target re-prefills the prefix);
+    # refined online from observed prefill timings when available.
+    prefill_s_per_token: float = 1.0e-3
+    # Never page-ship prefixes whose estimated KV footprint exceeds this
+    # (the transfer would monopolize the relay; migrate instead).
+    page_ship_max_bytes: int = 64 * 1024 * 1024
+    # EMA smoothing for the measured-rate updates (0 disables learning).
+    cost_ema_alpha: float = 0.2

@@ -52,7 +52,9 @@ from ..distributed.directory import DirectoryClient
 from ..distributed.messages import pack_frame, unpack_frame
 from ..distributed.relay import RelayClient
 from ..engine.sampling import SamplingOptions
-from .kv_codec import decode_session, encode_session
+from .kv_codec import (
+    decode_pages, decode_session, encode_error, encode_pages, encode_session,
+)
 
 __all__ = ["DecodeNode"]
 
@@ -75,6 +77,9 @@ class _Route:
     replay: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
     ckpted: bool = False
     last_ckpt_tick: int = 0
+    # Marked by a fleet.migrate rebalance request: the driver hands this
+    # stream back to its gateway at the next tick boundary.
+    handoff: bool = False
 
 
 class DecodeNode:
@@ -105,6 +110,10 @@ class DecodeNode:
         self.metrics = engine.metrics
         self._stop = threading.Event()
         self._ticks = 0
+        # distcheck: unguarded-ok(one-way bool set by the consume thread on
+        # fleet.drain; the drive/health threads only read it, and a stale
+        # read just delays the handoff/draining-advertise by one iteration)
+        self._draining = False
         # engine gen_id -> _Route, plus the gateway-id reverse map for
         # cancels. Consume thread inserts, driver thread reads/retires —
         # every access under the lock; frames are SENT outside it.
@@ -167,6 +176,18 @@ class DecodeNode:
                 if op == "migrate.cancel":
                     self._handle_cancel(header)
                     continue  # distcheck: reply-ok(cancel acks ride the token stream)
+                if op == "fleet.drain":
+                    self._handle_drain(header)
+                    continue  # distcheck: reply-ok(fleet.ack sent by _handle_drain)
+                if op == "fleet.migrate":
+                    self._handle_migrate(header)
+                    continue  # distcheck: reply-ok(fleet.ack sent by _handle_migrate)
+                if op == "fleet.pages":
+                    self._handle_pages(header)
+                    continue  # distcheck: reply-ok(page frames or an error frame sent)
+                if op == "fleet.pages.put":
+                    self._handle_pages_put(header, client)
+                    continue  # distcheck: reply-ok(fleet.ack/nack sent by the handler)
                 if op not in ("migrate.submit", "migrate.resume"):
                     self.metrics.counter("unknown_ops_dropped")
                     continue
@@ -249,6 +270,101 @@ class DecodeNode:
         if gid is not None:
             self.engine.cancel(gid)
 
+    # -- fleet ops (drain / rebalance / page-ship) ----------------------------
+
+    def _handle_drain(self, header: dict) -> None:
+        """fleet.drain: stop taking routing traffic (the next heartbeat
+        advertises ``draining``) and hand every in-flight stream back to
+        its gateway at the next tick boundary. The ack reports how many
+        sessions are in flight; the controller then watches the
+        directory load and fences the lease once it reaches zero."""
+        self._draining = True
+        with self._rlock:
+            n = len(self._routes)
+        reply = header.get("reply")
+        if reply:
+            self._send([(reply, pack_frame({
+                "op": "fleet.ack", "what": "drain", "ok": True, "n": n,
+            }))])
+
+    def _handle_migrate(self, header: dict) -> None:
+        """fleet.migrate: mark up to ``n`` streams for a tick-boundary
+        handoff — longest-running first (most decode ticks survived), the
+        rebalance heuristic: old streams hold the most KV pages, so
+        moving them defragments this node fastest."""
+        want = int(header.get("n") or 0)
+        marked = 0
+        with self._rlock:
+            routes = sorted(self._routes.values(),
+                            key=lambda r: r.seq - r.seq0, reverse=True)
+            for r in routes:
+                if marked >= want:
+                    break
+                if not r.handoff:
+                    r.handoff = True
+                    marked += 1
+        reply = header.get("reply")
+        if reply:
+            self._send([(reply, pack_frame({
+                "op": "fleet.ack", "what": "migrate", "ok": True, "n": marked,
+            }))])
+
+    def _handle_pages(self, header: dict) -> None:
+        """fleet.pages: export this node's cached prefix pages for the
+        prompt as kv_codec frames (the holder side of a page-ship)."""
+        gen = str(header.get("gen", ""))
+        reply = header.get("reply")
+        if not reply:
+            self.metrics.counter("malformed_frames")
+            return  # distcheck: reply-ok(frame carries no reply address)
+        try:
+            prompt = [int(t) for t in header["prompt"]]
+            ps, items = self.engine.export_prefix_pages(prompt)
+            if not items:
+                raise LookupError("no cached prefix pages for prompt")
+            frames = encode_pages(
+                gen, ps, items, max_frame_bytes=self.dcfg.kv_frame_bytes,
+            )
+        except Exception as e:
+            self._send([(reply, encode_error(gen, repr(e)))])
+            return  # distcheck: reply-ok(error frame sent)
+        if self._send([(reply, f) for f in frames]):
+            self.metrics.counter("fleet_pages_served", len(items))
+
+    def _handle_pages_put(self, header: dict, client: RelayClient) -> None:
+        """fleet.pages.put: pull shipped prefix-page frames off the relay
+        and install them into this engine's pool (the target side of a
+        page-ship); ack with the count made servable."""
+        gen = str(header.get("gen", ""))
+        reply = header.get("reply")
+        try:
+            kvq = header["kv"]
+            nf = int(header["nf"])
+            budget = time.monotonic() + self.dcfg.transfer_timeout_s
+            frames = [
+                client.get(kvq, timeout=max(budget - time.monotonic(), 0.001))
+                for _ in range(nf)
+            ]
+            items, meta = decode_pages(frames)
+            if items is None:
+                raise ValueError("page-ship transfer carried an error frame")
+            n = self.engine.import_prefix_pages(
+                int(meta.get("ps") or 0), items)
+        except Exception as e:
+            logger.warning(
+                "page import %s failed on %s: %r", gen, self.node_id, e)
+            if reply:
+                self._send([(reply, pack_frame({
+                    "op": "fleet.ack", "what": "pages", "ok": False,
+                    "gen": gen, "error": repr(e),
+                }))])
+            return  # distcheck: reply-ok(nack sent when a reply address exists)
+        if reply:
+            self._send([(reply, pack_frame({
+                "op": "fleet.ack", "what": "pages", "ok": True,
+                "gen": gen, "n": n,
+            }))])
+
     def _send_err(self, reply: str, gen: str, att: str, error: str) -> None:
         try:
             self._out.put(reply, pack_frame(
@@ -261,6 +377,7 @@ class DecodeNode:
 
     def _drive(self) -> None:
         while not self._stop.is_set():
+            self._run_handoffs()
             if not self.engine.has_work():
                 self._flush_replays()
                 time.sleep(0.002)
@@ -311,6 +428,50 @@ class DecodeNode:
                             self._by_gen.pop(r.gen, None)
             self._ship_checkpoints()
             self.engine.collect_finished()
+
+    def _run_handoffs(self) -> None:
+        """Tick-boundary session handoffs: every route when draining,
+        marked routes after a fleet.migrate. Runs between engine steps so
+        exported snapshots are quiesced (no in-flight tick)."""
+        with self._rlock:
+            if self._draining:
+                due = list(self._routes.items())
+            else:
+                due = [(g, r) for g, r in self._routes.items() if r.handoff]
+        for gid, r in due:
+            self._handoff_route(gid, r)
+
+    def _handoff_route(self, gid: str, r: _Route) -> None:
+        """Hand one stream back to its gateway: flush any replay tail,
+        ship a fresh tick-boundary checkpoint, then the ``fleet.handoff``
+        marker the gateway re-homes the stream from (exactly-once: the
+        gateway's seq dedup absorbs any token overlap between the stream
+        and the checkpoint tail). A WAITING session (never streamed)
+        exports ``None`` and hands off cold — the gateway resubmits the
+        prompt, still zero-loss because nothing was ever delivered."""
+        self._flush_replay_route(r)
+        frames: List[Tuple[str, bytes]] = []
+        snap = self.engine.export_session(gid)
+        if snap is not None:
+            frames = [(r.reply, f) for f in encode_session(
+                r.gen, snap,
+                page_size=self.engine.ccfg.page_size,
+                max_frame_bytes=self.dcfg.kv_frame_bytes,
+                att=r.att,
+            )]
+        frames.append((r.reply, pack_frame({
+            "op": "fleet.handoff", "gen": r.gen, "att": r.att,
+        })))
+        # Retire the route BEFORE cancelling: the cancel's finish event
+        # must not chase the handoff down the reply queue as a bogus fin.
+        with self._rlock:
+            self._routes.pop(gid, None)
+            self._by_gen.pop(r.gen, None)
+        if self._send(frames):
+            self.metrics.counter("fleet_handoffs_sent")
+        # Either way the session leaves this engine: on send failure the
+        # gateway's death detector re-homes from its last checkpoint.
+        self.engine.cancel(gid)
 
     def _flush_replays(self) -> None:
         with self._rlock:
@@ -375,9 +536,18 @@ class DecodeNode:
         beat = min(self.dcfg.heartbeat_s, max(self.lease_ttl / 3.0, 0.05))
         while not self._stop.wait(beat):
             try:
+                # Load counts every in-flight ROUTE, not just resident
+                # engine slots: a queued (WAITING) stream is offered load
+                # to a gateway picking seats, and the fleet controller's
+                # drain poll must not read "0" while un-handed-off
+                # sessions still sit in this node's admission queue.
+                with self._rlock:
+                    n_routes = len(self._routes)
                 alive = self._directory.heartbeat(
-                    self.node_id, load=self.engine.active_sessions(),
+                    self.node_id,
+                    load=max(self.engine.active_sessions(), n_routes),
                     ttl=self.lease_ttl, epoch=self.epoch,
+                    draining=self._draining,
                 )
                 if not alive:  # lease lapsed (e.g. partition healed)
                     if not self._register():

@@ -259,3 +259,62 @@ def decode_session(
     snapshot = dict(sess)
     snapshot["planes"] = planes
     return snapshot, meta
+
+
+def encode_pages(
+    gen_id: str,
+    page_size: int,
+    items: Sequence[Tuple[bytes, Dict[str, "np.ndarray"]]],
+    *,
+    max_frame_bytes: int = 4 * 1024 * 1024,
+    op: str = "fleet.pages",
+) -> List[bytes]:
+    """Serialize content-addressed prefix pages for a fleet page-ship
+    (node-to-node cache copy). Each ``(key, tiles)`` item is one page's
+    stored-form tiles from ``engine.export_prefix_pages``; the tiles
+    ride the payload as planes named ``"<index>/<plane>"`` and the page
+    keys ride the header's ``chain`` in the same order, so the transfer
+    reuses the existing frame schema with no new header fields."""
+    planes: Dict[str, "np.ndarray"] = {}
+    quant = False
+    for i, (_, tiles) in enumerate(items):
+        quant = quant or "ks" in tiles
+        for name, arr in tiles.items():
+            planes[f"{i}/{name}"] = arr
+    return encode_kv(
+        gen_id, planes,
+        n_valid=len(items) * int(page_size),
+        first_token=-1,
+        chain=[key for key, _ in items],
+        page_size=page_size,
+        quant=quant,
+        max_frame_bytes=max_frame_bytes,
+        op=op,
+    )
+
+
+def decode_pages(
+    frames: Iterable[bytes],
+) -> Tuple[Optional[List[Tuple[bytes, Dict[str, "np.ndarray"]]]], dict]:
+    """Reassemble :func:`encode_pages` frames back into the ordered
+    ``(key, tiles)`` list ``engine.import_prefix_pages`` accepts; the
+    page size rides back in ``meta["ps"]``.
+
+    Returns ``(items, meta)``; an error frame returns ``(None, meta)``
+    with ``meta["error"]`` set. Raises ``ValueError`` on any integrity
+    violation :func:`decode_kv` detects, or when a chain key has no
+    tiles in the payload (a torn or mislabeled transfer)."""
+    planes, meta = decode_kv(frames)
+    if planes is None:
+        return None, meta
+    by_page: Dict[int, Dict[str, "np.ndarray"]] = {}
+    for name, arr in planes.items():
+        idx, _, plane = name.partition("/")
+        by_page.setdefault(int(idx), {})[plane] = arr
+    items: List[Tuple[bytes, Dict[str, "np.ndarray"]]] = []
+    for i, key in enumerate(meta["chain"]):
+        tiles = by_page.get(i)
+        if not tiles:
+            raise ValueError(f"page-ship payload missing page {i}")
+        items.append((key, tiles))
+    return items, meta

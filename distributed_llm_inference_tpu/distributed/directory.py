@@ -45,6 +45,10 @@ class NodeInfo:
     # member), or "prefill" (prefill-only worker — excluded from layer
     # routes; disaggregated gateways pick it by role instead).
     role: str = "both"
+    # The node is handing its sessions off (fleet drain / scale-in):
+    # gateways stop routing new work to it, but in-flight streams keep
+    # flowing until the handoff lands and the lease is fenced.
+    draining: bool = False
     # Monotonically increasing incarnation number the node picked when it
     # (re)started. Registrations and heartbeats carrying an epoch OLDER
     # than the table's are rejected — a partitioned zombie that wakes up
@@ -133,7 +137,8 @@ class BlockDirectory:
 
     def heartbeat(self, node_id: str, load: int = 0,
                   ttl: Optional[float] = None,
-                  epoch: Optional[int] = None) -> bool:
+                  epoch: Optional[int] = None,
+                  draining: bool = False) -> bool:
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None:
@@ -146,6 +151,7 @@ class BlockDirectory:
                 return False
             info.lease_expiry = time.monotonic() + (ttl or self.default_ttl)
             info.load = load
+            info.draining = bool(draining)
             return True
 
     def remove(self, node_id: str) -> None:
@@ -370,7 +376,8 @@ class DirectoryService:
                 return {"ok": True, "accepted": accepted}
             if op == "heartbeat":
                 ok = d.heartbeat(req["node_id"], req.get("load", 0),
-                                 req.get("ttl"), req.get("epoch"))
+                                 req.get("ttl"), req.get("epoch"),
+                                 req.get("draining", False))
                 return {"ok": ok}
             if op == "remove":
                 d.remove(req["node_id"])
@@ -405,7 +412,7 @@ class DirectoryService:
                     {"node_id": n.node_id, "first_layer": n.first_layer,
                      "last_layer": n.last_layer, "queue": n.queue,
                      "load": n.load, "pending": n.pending, "role": n.role,
-                     "epoch": n.epoch}
+                     "draining": n.draining, "epoch": n.epoch}
                     for n in d.alive()
                 ]}
             return {"ok": False, "error": f"unknown op {op!r}"}
@@ -471,9 +478,11 @@ class DirectoryClient:
 
     def heartbeat(self, node_id: str, load: int = 0,
                   ttl: Optional[float] = None,
-                  epoch: Optional[int] = None) -> bool:
+                  epoch: Optional[int] = None,
+                  draining: bool = False) -> bool:
         return self._call({"op": "heartbeat", "node_id": node_id,
-                           "load": load, "ttl": ttl, "epoch": epoch})["ok"]
+                           "load": load, "ttl": ttl, "epoch": epoch,
+                           "draining": draining})["ok"]
 
     def remove(self, node_id: str) -> None:
         self._call({"op": "remove", "node_id": node_id})

@@ -1341,6 +1341,75 @@ class InferenceEngine:
                 matched += ps
         return matched
 
+    def export_prefix_pages(self, prompt):
+        """Stored-form tiles of the longest locally cached prefix of
+        ``prompt`` — device registry pages plus spill-arena entries,
+        page-granular, WITHOUT taking page references — for the fleet
+        page-ship path (``fleet.pages``). Returns ``(page_size, items)``
+        where ``items`` is an ordered ``[(chain_key, tiles), ...]`` list
+        ready for ``kv_codec.encode_pages``; empty when prefix caching
+        is off or nothing matches. Tiles round-trip verbatim, so the
+        importer's pages are bit-exact with this node's."""
+        if self.allocator is None or not self.ccfg.prefix_caching:
+            return self.ccfg.page_size, []
+        ps = self.ccfg.page_size
+        keys = PageAllocator.chain_keys(prompt, ps)
+        items = []
+        with self._lock:
+            for key in keys:
+                page = self.allocator.peek(key)
+                if page is not None:
+                    items.append((key, self.cache.read_page(page)))
+                    continue
+                tiles = (self._spill.peek(key)
+                         if self._spill is not None else None)
+                if tiles is None:
+                    break
+                items.append((key, tiles))
+        return ps, items
+
+    def import_prefix_pages(self, page_size: int, items) -> int:
+        """Install shipped prefix pages (``kv_codec.decode_pages`` items)
+        into this engine's pool: each page lands registered at refcount
+        0 — immediately servable to prefix-matching admissions, evictable
+        (LRU, via the spill arena when configured) under pressure, exactly
+        like a page left behind by a released session. Already-resident
+        keys are skipped; pool pressure parks tiles in the arena instead
+        (still servable); a tile/page-shape mismatch raises ``ValueError``
+        after freeing the staged page. Returns pages made servable."""
+        if self.allocator is None or not self.ccfg.prefix_caching:
+            return 0
+        if int(page_size) != self.ccfg.page_size:
+            raise ValueError(
+                f"page-ship size {page_size} != pool page size "
+                f"{self.ccfg.page_size}")
+        installed = 0
+        with self._lock:
+            for key, tiles in items:
+                if self.allocator.peek(key) is not None:
+                    continue  # already device-resident
+                if self._spill is not None and key in self._spill:
+                    continue  # already arena-resident
+                try:
+                    [page] = self.allocator.alloc(1)
+                except MemoryError:
+                    if self._spill is not None and self._spill.put(key, tiles):
+                        installed += 1  # servable from the arena
+                        continue
+                    break
+                try:
+                    self.cache = self.cache.write_page(page, tiles)
+                except ValueError:
+                    self.allocator.free([page])
+                    self.metrics.counter("prefix_reload_errors")
+                    raise
+                self.allocator.register(page, key)
+                self.allocator.free([page])  # registered, refcount 0
+                installed += 1
+        if installed:
+            self.metrics.counter("fleet_pages_imported", installed)
+        return installed
+
     # -- disaggregated prefill/decode (disagg/) -------------------------------
 
     def prefill_export(self, prompt, options=None):

@@ -1,0 +1,20 @@
+"""Elastic fleet control plane: drain, rebalance, and autoscale the
+decode pool on top of the byte-exact live-migration primitives.
+
+* :mod:`.controller` — :class:`FleetController`, the drain /
+  rebalance / autoscale driver (crash recovery's proactive twin).
+* :mod:`.costmodel` — :class:`CostModel`, the measured
+  bytes-vs-latency arbiter between query-move, page-ship, and plain
+  migration when a prefix holder is overloaded.
+* :mod:`.policy` — the shared directory-row placement filters used by
+  both the controller and the recovery gateway.
+"""
+
+from .controller import FleetController
+from .costmodel import CostModel
+from .policy import hot_rows, least_loaded, live_decode_rows, mean_load
+
+__all__ = [
+    "FleetController", "CostModel",
+    "live_decode_rows", "least_loaded", "hot_rows", "mean_load",
+]

@@ -1,0 +1,251 @@
+"""Elastic fleet controller: drain, rebalance, and autoscale the decode
+pool with zero token loss.
+
+The controller is a *policy* layer over the PR-8 migration primitives —
+the same ``export_session`` → ``migrate.ckpt`` → ``resume_session``
+machinery that crash recovery (``serving.backends.FleetBackend``) uses
+reactively, driven here proactively:
+
+* :meth:`FleetController.drain` asks a decode node (``fleet.drain``
+  frame) to hand off every in-flight session: the node ships a fresh
+  checkpoint plus a ``fleet.handoff`` marker down each stream's reply
+  queue, the gateways re-home the streams exactly-once through their
+  existing recovery path, and only once the node's directory load hits
+  zero (or the drain times out — stragglers then re-home through plain
+  crash recovery, still exactly-once) is the lease **fenced**.
+* :meth:`FleetController.rebalance_once` finds hot nodes from the
+  heartbeat load signal and asks them (``fleet.migrate``) to shed their
+  longest-running sessions, defragmenting KV for big-batch admissions.
+* :meth:`FleetController.start` runs the autoscale control loop:
+  sustained high mean load spawns a warm standby (the ``spawn``
+  callback registers a fresh decode node), sustained low load drains
+  the least-loaded node and retires it (``retire`` callback) —
+  drain-then-fence, never fence-then-hope.
+
+Threading contract: the controller is single-owner. Either drive it
+from one caller thread (``drain`` / ``rebalance_once`` /
+``autoscale_once``), or hand it to the background loop with
+``start()`` — not both concurrently (the relay client is
+one-connection-per-consumer).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..config import DisaggConfig, FleetConfig
+from ..distributed.directory import DirectoryClient
+from ..distributed.messages import pack_frame, unpack_frame
+from ..distributed.relay import RelayClient
+from ..utils.metrics import Metrics
+from .policy import by_node_id, hot_rows, least_loaded, live_decode_rows, mean_load
+
+log = logging.getLogger(__name__)
+
+
+class FleetController:
+    def __init__(
+        self,
+        relay_port: int,
+        host: str = "127.0.0.1",
+        fleet_cfg: Optional[FleetConfig] = None,
+        disagg_cfg: Optional[DisaggConfig] = None,
+        spawn: Optional[Callable[[], object]] = None,
+        retire: Optional[Callable[[str], None]] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.fcfg = fleet_cfg or FleetConfig()
+        self.dcfg = disagg_cfg or DisaggConfig()
+        self.metrics = metrics or Metrics()
+        self._spawn = spawn
+        self._retire = retire
+        self._directory = DirectoryClient(relay_port, host)
+        self._client = RelayClient(host, relay_port)
+        self._reply = f"fleet.ctl.{uuid.uuid4().hex[:12]}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Autoscale hysteresis clocks (single-owner; see module docstring).
+        self._over_since: Optional[float] = None
+        self._under_since: Optional[float] = None
+
+    def close(self) -> None:
+        self.stop()
+        self._client.close()
+        self._directory.close()
+
+    # --- drain -----------------------------------------------------------
+
+    def drain(self, node_id: str, timeout: Optional[float] = None) -> dict:
+        """Release ``node_id``: live-migrate its in-flight sessions off,
+        then fence its lease. Returns a summary dict with the number of
+        sessions the node reported in flight (``-1`` if its ack never
+        arrived), whether the load observably hit zero before the fence,
+        and the new fence floor. Fencing after a timeout is still safe:
+        shipped checkpoints re-home any straggler through the gateways'
+        crash-recovery path, exactly-once either way."""
+        row = by_node_id(self._directory.alive()).get(node_id)
+        if row is None:
+            raise LookupError(f"node {node_id!r} not alive in the directory")
+        epoch = row.get("epoch")
+        self.metrics.counter("fleet_drains")
+        self._client.put(row["queue"], pack_frame(
+            {"op": "fleet.drain", "reply": self._reply}))
+        ack = self._await_ack("drain", timeout=2.0)
+        sessions = int(ack.get("n", 0)) if ack else -1
+        budget = self.fcfg.drain_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        drained = False
+        while time.monotonic() < deadline:
+            row = by_node_id(self._directory.alive()).get(node_id)
+            if row is None:  # lease lapsed: nothing left to wait for
+                drained = True
+                break
+            # Only trust a zero load AFTER the row advertises draining:
+            # heartbeats lag the drain command, and fencing on a stale
+            # pre-drain "load 0" beat would cut down sessions that never
+            # got handed off.
+            if row.get("draining") and int(row.get("load", 0)) <= 0:
+                drained = True
+                break
+            time.sleep(0.05)
+        floor = self._directory.fence(node_id, epoch)
+        log.info("fleet: drained %s (sessions=%d drained=%s floor=%d)",
+                 node_id, sessions, drained, floor)
+        return {"node_id": node_id, "sessions": sessions,
+                "drained": drained, "floor": floor}
+
+    # --- rebalance -------------------------------------------------------
+
+    def rebalance_once(self) -> int:
+        """One hot-node scan: ask every node hotter than
+        ``hot_load_factor`` x the pool mean to migrate its
+        longest-running sessions off (they land on cooler nodes via the
+        gateways' normal pick). Returns sessions asked to move."""
+        rows = live_decode_rows(self._directory.alive())
+        moved = 0
+        for row in hot_rows(rows, self.fcfg.hot_load_factor):
+            want = min(self.fcfg.rebalance_max_sessions,
+                       int(row.get("load", 0)))
+            if want <= 0:
+                continue
+            self._client.put(row["queue"], pack_frame(
+                {"op": "fleet.migrate", "n": want, "reply": self._reply}))
+            ack = self._await_ack("migrate", timeout=2.0)
+            got = int(ack.get("n", 0)) if ack else 0
+            if got > 0:
+                self.metrics.counter("fleet_rebalance_migrations", got)
+                moved += got
+        return moved
+
+    # --- autoscale -------------------------------------------------------
+
+    def autoscale_once(self, now: Optional[float] = None) -> str:
+        """One control-loop evaluation against the directory's offered
+        load. Returns the action taken: ``"out"`` (spawned), ``"in"``
+        (drained + retired), or ``"hold"``. Scale decisions need the
+        load signal to *hold* past ``scale_hold_s`` so a single burst
+        tick does not thrash the pool."""
+        now = time.monotonic() if now is None else now
+        rows = live_decode_rows(self._directory.alive())
+        pool = len(rows)
+        self.metrics.gauge("fleet_pool_size", float(pool))
+        if pool < self.fcfg.min_nodes:
+            if self._spawn is not None:
+                self._spawn()
+                self.metrics.counter("fleet_scale_out")
+                return "out"
+            return "hold"
+        avg = mean_load(rows)
+        if avg > self.fcfg.scale_out_load and pool < self.fcfg.max_nodes:
+            self._under_since = None
+            if self._over_since is None:
+                self._over_since = now
+            elif now - self._over_since >= self.fcfg.scale_hold_s:
+                self._over_since = None
+                if self._spawn is not None:
+                    self._spawn()
+                    self.metrics.counter("fleet_scale_out")
+                    return "out"
+        elif avg < self.fcfg.scale_in_load and pool > self.fcfg.min_nodes:
+            self._over_since = None
+            if self._under_since is None:
+                self._under_since = now
+            elif now - self._under_since >= self.fcfg.scale_hold_s:
+                self._under_since = None
+                victim = least_loaded(rows)
+                if victim is not None:
+                    self.drain(victim["node_id"])
+                    self.metrics.counter("fleet_scale_in")
+                    if self._retire is not None:
+                        self._retire(victim["node_id"])
+                    return "in"
+        else:
+            self._over_since = None
+            self._under_since = None
+        return "hold"
+
+    # --- control loop ----------------------------------------------------
+
+    def start(self) -> None:
+        """Run autoscale + rebalance on their configured periods in a
+        daemon thread until :meth:`stop`. Takes ownership: do not call
+        the public one-shot methods from other threads while running."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-controller", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _run(self) -> None:
+        next_rebalance = time.monotonic() + self.fcfg.rebalance_interval_s
+        while not self._stop.is_set():
+            try:
+                self.autoscale_once()
+                if time.monotonic() >= next_rebalance:
+                    self.rebalance_once()
+                    next_rebalance = (time.monotonic()
+                                      + self.fcfg.rebalance_interval_s)
+            except Exception:
+                log.exception("fleet: control tick failed; continuing")
+            self._stop.wait(self.fcfg.autoscale_interval_s)
+
+    # --- plumbing --------------------------------------------------------
+
+    def status(self) -> dict:
+        """Directory snapshot for the CLI: all rows plus the routable
+        decode pool size and its mean load."""
+        rows = self._directory.alive()
+        live = live_decode_rows(rows)
+        return {"nodes": rows, "pool": len(live), "mean_load": mean_load(live)}
+
+    def _await_ack(self, what: str, timeout: float) -> Optional[dict]:
+        """Wait for a ``fleet.ack`` of kind ``what`` on the controller's
+        reply queue; drops unrelated frames (counted)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                frame = self._client.get(self._reply, timeout=remaining)
+            except TimeoutError:
+                return None
+            try:
+                header, _ = unpack_frame(frame)
+            except Exception:
+                self.metrics.counter("malformed_frames")
+                continue
+            if header.get("op") == "fleet.ack" and header.get("what") == what:
+                return header
+            self.metrics.counter("unknown_ops_dropped")

@@ -1,0 +1,111 @@
+"""Bytes-vs-latency placement arbiter for overloaded prefix holders.
+
+When prefix routing finds a cached prefix on a node that is busier than
+the best alternative, three placements are on the table ("Move the
+Query, Not the Cache", PAPERS.md):
+
+* ``query_move`` — send the request to the holder anyway: pay its queue,
+  reuse the cache for free.
+* ``page_ship``  — copy the prefix's KV pages holder → target over the
+  relay, then decode on the idle target: pay 2x the prefix bytes on the
+  wire (holder→gateway→target hops), skip the recompute.
+* ``migrate``    — decode on the idle target cold: recompute the prefix
+  (prefill) there, touch no extra wire bytes.
+
+Each option's latency is estimated from a mix of config seeds and
+online measurements (:class:`FleetConfig` documents the knobs); the
+wire rate and prefill rate are refined by EMA from observed transfers
+so the crossover tracks the deployment, not the defaults. Every
+``decide()`` bumps exactly one of the ``fleet_query_moved`` /
+``fleet_pages_fetched`` / ``fleet_migrated`` counters — the /metrics
+evidence of which way the fleet is leaning.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import FleetConfig
+from ..utils.metrics import Metrics
+
+# Deterministic preference on exact cost ties: the options ordered by
+# operational risk (query_move touches nothing, page_ship moves bytes,
+# migrate burns compute).
+_TIE_ORDER = ("query_move", "page_ship", "migrate")
+
+
+class CostModel:
+    """Measured latency estimates for the three placements (seconds)."""
+
+    def __init__(self, cfg: Optional[FleetConfig] = None,
+                 metrics: Optional[Metrics] = None):
+        cfg = cfg or FleetConfig()
+        self.cfg = cfg
+        self.metrics = metrics
+        # Mutable, EMA-refined copies of the config seeds.
+        self.wire_bytes_per_s = float(cfg.wire_bytes_per_s)
+        self.prefill_s_per_token = float(cfg.prefill_s_per_token)
+
+    # --- estimates -------------------------------------------------------
+
+    def prefix_bytes(self, matched_tokens: int) -> float:
+        return float(matched_tokens) * self.cfg.kv_bytes_per_token
+
+    def est_query_move(self, holder_load: float, alt_load: float) -> float:
+        """Extra queueing latency of decoding on the busier holder."""
+        return max(0.0, float(holder_load) - float(alt_load)) \
+            * self.cfg.queue_s_per_load
+
+    def est_page_ship(self, matched_tokens: int) -> float:
+        """Wire time of moving the prefix KV holder→gateway→target."""
+        return 2.0 * self.prefix_bytes(matched_tokens) \
+            / max(self.wire_bytes_per_s, 1.0)
+
+    def est_migrate(self, matched_tokens: int) -> float:
+        """Recompute time of re-prefilling the prefix on the target."""
+        return float(matched_tokens) * self.prefill_s_per_token
+
+    # --- decision --------------------------------------------------------
+
+    def decide(self, matched_tokens: int, holder_load: float,
+               alt_load: float) -> str:
+        """Pick the cheapest placement; returns ``"query_move"`` /
+        ``"page_ship"`` / ``"migrate"`` and tallies the matching
+        decision counter."""
+        costs = {
+            "query_move": self.est_query_move(holder_load, alt_load),
+            "page_ship": self.est_page_ship(matched_tokens),
+            "migrate": self.est_migrate(matched_tokens),
+        }
+        if self.prefix_bytes(matched_tokens) > self.cfg.page_ship_max_bytes:
+            del costs["page_ship"]
+        choice = min(costs, key=lambda k: (costs[k], _TIE_ORDER.index(k)))
+        if self.metrics is not None:
+            if choice == "query_move":
+                self.metrics.counter("fleet_query_moved")
+            elif choice == "page_ship":
+                self.metrics.counter("fleet_pages_fetched")
+            else:
+                self.metrics.counter("fleet_migrated")
+        return choice
+
+    # --- online refinement -----------------------------------------------
+
+    def _ema(self, old: float, sample: float) -> float:
+        a = self.cfg.cost_ema_alpha
+        return old if a <= 0 else (1.0 - a) * old + a * sample
+
+    def observe_ship(self, nbytes: int, seconds: float) -> None:
+        """Feed one measured page-ship round trip (``nbytes`` of frames,
+        two relay hops) back into the wire-rate estimate."""
+        if nbytes <= 0 or seconds <= 0:
+            return
+        self.wire_bytes_per_s = self._ema(
+            self.wire_bytes_per_s, 2.0 * nbytes / seconds)
+
+    def observe_prefill(self, tokens: int, seconds: float) -> None:
+        """Feed one measured prefill into the recompute-rate estimate."""
+        if tokens <= 0 or seconds <= 0:
+            return
+        self.prefill_s_per_token = self._ema(
+            self.prefill_s_per_token, seconds / tokens)

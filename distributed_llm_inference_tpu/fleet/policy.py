@@ -1,0 +1,73 @@
+"""Shared placement policy over directory ``alive()`` rows.
+
+The fleet layer and the crash-recovery gateway
+(:class:`..serving.backends.FleetBackend`) pick decode nodes from the
+same directory snapshot; these helpers are the single definition of
+which rows are *routable* (decode role, registered — not a pending
+``assign()`` reservation — not draining, not locally fenced) so drain
+semantics cannot drift between the controller and the gateways.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+def live_decode_rows(
+    rows: Iterable[dict],
+    dead_ids: Iterable[str] = (),
+    include_draining: bool = False,
+) -> List[dict]:
+    """Filter directory ``alive()`` rows down to routable decode nodes.
+
+    ``dead_ids`` is the caller's local fence set (nodes it has declared
+    dead this stream even if their lease has not expired yet). Draining
+    nodes are excluded by default — they still serve in-flight streams
+    but must not receive new placements.
+    """
+    dead = set(dead_ids)
+    out = []
+    for n in rows:
+        if n.get("role") != "decode" or n.get("pending"):
+            continue
+        if n.get("node_id") in dead:
+            continue
+        if n.get("draining") and not include_draining:
+            continue
+        out.append(n)
+    return out
+
+
+def least_loaded(rows: Iterable[dict]) -> Optional[dict]:
+    """The row with the lowest heartbeat load (node-id tiebreak so the
+    choice is deterministic across gateways seeing the same snapshot)."""
+    return min(
+        rows,
+        key=lambda n: (n.get("load", 0), str(n.get("node_id", ""))),
+        default=None,
+    )
+
+
+def mean_load(rows: Iterable[dict]) -> float:
+    rows = list(rows)
+    if not rows:
+        return 0.0
+    return sum(int(n.get("load", 0)) for n in rows) / len(rows)
+
+
+def hot_rows(rows: Iterable[dict], factor: float) -> List[dict]:
+    """Rows whose load strictly exceeds ``factor`` x the pool mean —
+    rebalance candidates. Needs >= 2 rows (with one node there is
+    nowhere to move work) and a strictly positive mean (an idle pool
+    has no hot member)."""
+    rows = list(rows)
+    if len(rows) < 2:
+        return []
+    mean = mean_load(rows)
+    if mean <= 0:
+        return []
+    return [n for n in rows if int(n.get("load", 0)) > factor * mean]
+
+
+def by_node_id(rows: Iterable[dict]) -> Dict[str, dict]:
+    return {str(n.get("node_id")): n for n in rows}

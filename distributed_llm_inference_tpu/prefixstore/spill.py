@@ -69,5 +69,15 @@ class HostSpillArena:
             self.bytes_used -= self._sizes.pop(key)
         return tiles
 
+    def peek(self, key: bytes) -> Optional[Dict[str, np.ndarray]]:
+        """Return the tiles for ``key`` WITHOUT removing them (``None``
+        on miss) — for read-only exports like the fleet page-ship, where
+        the page stays arena-resident and servable here. Refreshes the
+        entry's LRU recency (a shipped page is evidently in demand)."""
+        tiles = self._entries.get(key)
+        if tiles is not None:
+            self._entries.move_to_end(key)
+        return tiles
+
     def keys(self):
         return list(self._entries)

@@ -42,8 +42,10 @@ import time
 import uuid
 from typing import Dict, List, Optional, Sequence
 
-from ..config import DisaggConfig, PrefixConfig, SchedConfig
+from ..config import DisaggConfig, FleetConfig, PrefixConfig, SchedConfig
 from ..engine.sampling import SamplingOptions
+from ..fleet.costmodel import CostModel
+from ..fleet.policy import least_loaded, live_decode_rows
 from ..sched.placement import choose_decode_node, prefix_worth_detour
 from ..utils.metrics import Metrics
 
@@ -823,6 +825,16 @@ class FleetBackend(Backend):
     deadline is under ``shed_headroom_s`` x the number of concurrent
     recoveries — a recovery storm must not burn decode on streams that
     cannot finish in time.
+
+    The same machinery serves the elastic fleet (fleet/): a node being
+    drained or rebalanced ships a fresh checkpoint followed by a
+    ``fleet.handoff`` marker, and the gateway re-homes the stream through
+    this recovery path — proactive migration and crash recovery are one
+    code path, exactly-once either way. Placement is shared with the
+    controller via ``fleet.policy`` (draining nodes take no new work),
+    and with ``fleet_cfg`` set the bytes-vs-latency cost model arbitrates
+    overloaded-prefix-holder placements between query-move, page-ship,
+    and plain migration.
     """
 
     def __init__(
@@ -834,6 +846,7 @@ class FleetBackend(Backend):
         pool_wait_s: float = 2.0,
         prefix_cfg: Optional[PrefixConfig] = None,
         sched_cfg: Optional[SchedConfig] = None,
+        fleet_cfg: Optional[FleetConfig] = None,
     ):
         self.relay_host, self.relay_port = relay_host, relay_port
         self.dcfg = disagg_cfg or DisaggConfig()
@@ -842,6 +855,10 @@ class FleetBackend(Backend):
         # load-blind semantics (the advertised holder wins outright).
         self.kcfg = sched_cfg
         self.metrics = metrics or Metrics()
+        # None = cost-model placement off: prefix routing ignores holder
+        # load (or defers to the scheduler rule) exactly as before.
+        self.cost = (CostModel(fleet_cfg, self.metrics)
+                     if fleet_cfg is not None else None)
         self._dead_after = self.dcfg.dead_after_s or self.dcfg.lease_ttl_s
         self._pool_wait_s = pool_wait_s
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -923,11 +940,7 @@ class FleetBackend(Backend):
             if (nid is None or nid in dead_ids
                     or tokens < max(self.pcfg.min_shared_tokens, 1)):
                 return None
-            nodes = [
-                n for n in directory.alive()
-                if n.get("role") == "decode" and not n.get("pending")
-                and n.get("node_id") not in dead_ids
-            ]
+            nodes = live_decode_rows(directory.alive(), dead_ids)
             if self.kcfg is None:
                 best = next(
                     (n for n in nodes if n.get("node_id") == nid), None)
@@ -947,6 +960,103 @@ class FleetBackend(Backend):
             self._loop.call_soon_threadsafe(h.queue.put_nowait, ev)
         except RuntimeError:
             pass  # loop already closed (server exited mid-stream)
+
+    def _place_cost(self, directory, client, prompt, dead_ids):
+        """Bytes-vs-latency placement (fleet/costmodel.py): when the
+        prefix holder is busier than the best alternative, arbitrate per
+        event between decoding on the holder anyway (query-move), copying
+        the prefix pages to the idle node first (page-ship), and plain
+        migration (re-prefill there). ``None`` = no useful prefix match —
+        the caller falls back to the legacy picks. Probe-only: any
+        failure yields ``None``, never a failed request."""
+        if not self.pcfg.route_by_prefix:
+            return None
+        try:
+            nid, tokens = directory.match_prefix(prompt)
+            if (nid is None or nid in dead_ids
+                    or tokens < max(self.pcfg.min_shared_tokens, 1)):
+                return None
+            rows = live_decode_rows(directory.alive(), dead_ids)
+            holder = next(
+                (n for n in rows if n.get("node_id") == nid), None)
+            if holder is None:
+                return None
+            alt = least_loaded(
+                [n for n in rows if n.get("node_id") != nid])
+            if alt is None or (int(holder.get("load", 0))
+                               <= int(alt.get("load", 0))):
+                # The holder is also the cheapest seat: plain prefix
+                # routing, no decision event to arbitrate.
+                self.metrics.counter("routed_by_prefix")
+                return holder
+            choice = self.cost.decide(
+                tokens, holder.get("load", 0), alt.get("load", 0))
+            if choice == "query_move":
+                self.metrics.counter("routed_by_prefix")
+                return holder
+            if choice == "page_ship":
+                # Success or failure, decode lands on the idle target;
+                # a failed ship just means it re-prefills the prefix.
+                self._ship_pages(client, holder, alt, prompt)
+            return alt
+        except Exception:  # noqa: BLE001 - placement probe only
+            return None
+
+    def _ship_pages(self, client, holder, target, prompt) -> bool:
+        """Copy ``holder``'s cached prefix pages for ``prompt`` to
+        ``target`` over the relay (fleet.pages → fleet.pages.put) and
+        feed the measured round trip back into the cost model. Returns
+        True when the target acked the install."""
+        from ..disagg.kv_codec import _unpack
+        from ..distributed.messages import pack_frame, unpack_frame
+
+        t0 = time.monotonic()
+        budget = t0 + min(self.dcfg.transfer_timeout_s, 10.0)
+        pgq = f"fleet.pg.{uuid.uuid4().hex[:12]}"
+        try:
+            client.put(holder["queue"], pack_frame({
+                "op": "fleet.pages", "gen": pgq, "reply": pgq,
+                "prompt": prompt,
+            }))
+            frames: List[bytes] = []
+            nbytes = 0
+            total: Optional[int] = None
+            while total is None or len(frames) < total:
+                frame = client.get(
+                    pgq, timeout=max(budget - time.monotonic(), 0.001))
+                # kv_codec frames carry a multi-plane record payload, not
+                # pack_frame's single-array body: header-only parse here.
+                header, _ = _unpack(frame)
+                if header.get("error"):
+                    raise RuntimeError(str(header["error"]))
+                total = int(header["n"])
+                frames.append(frame)
+                nbytes += len(frame)
+            # Re-home the frames onto a fresh queue the target pulls from.
+            kvq = f"fleet.pg.{uuid.uuid4().hex[:12]}"
+            client.put_many((kvq, f) for f in frames)
+            ackq = f"fleet.ack.{uuid.uuid4().hex[:12]}"
+            client.put(target["queue"], pack_frame({
+                "op": "fleet.pages.put", "gen": pgq, "kv": kvq,
+                "nf": len(frames), "reply": ackq,
+            }))
+            while True:
+                frame = client.get(
+                    ackq, timeout=max(budget - time.monotonic(), 0.001))
+                header, _ = unpack_frame(frame)
+                if header.get("op") != "fleet.ack":
+                    self.metrics.counter("unknown_ops_dropped")
+                    continue
+                if not header.get("ok"):
+                    raise RuntimeError(str(header.get("error")))
+                break
+            dt = time.monotonic() - t0
+            self.metrics.observe("fleet_page_ship_ms", dt * 1e3)
+            self.cost.observe_ship(nbytes, dt)
+            return True
+        except Exception:  # noqa: BLE001 - ship is best-effort
+            self.metrics.counter("fleet_page_ship_failed")
+            return False
 
     def _run_fleet(self, h, key, prompt, options, deadline) -> None:
         from ..distributed.directory import DirectoryClient
@@ -1018,16 +1128,14 @@ class FleetBackend(Backend):
             end = time.monotonic() + wait_s
             while True:
                 try:
-                    nodes = [
-                        n for n in directory.alive()
-                        if n.get("role") == "decode"
-                        and not n.get("pending")
-                        and n.get("node_id") not in dead_ids
-                    ]
+                    # Shared placement rule (fleet/policy.py): routable =
+                    # decode role, registered, not draining, not locally
+                    # fenced — the same filter the fleet controller uses.
+                    nodes = live_decode_rows(directory.alive(), dead_ids)
                 except Exception:  # noqa: BLE001 - directory blip
                     nodes = []
                 if nodes:
-                    return min(nodes, key=lambda n: n.get("load", 0))
+                    return least_loaded(nodes)
                 if (time.monotonic() >= end or self._stop_evt.is_set()
                         or h.stop.is_set()):
                     return None
@@ -1107,7 +1215,11 @@ class FleetBackend(Backend):
             # prefill. Initial placement only: recovery placement (pick())
             # stays availability-first, and the dead node's advertisement
             # died with its lease anyway.
-            node = self._pick_prefix(directory, prompt, dead_ids)
+            node = None
+            if self.cost is not None:
+                node = self._place_cost(directory, client, prompt, dead_ids)
+            if node is None:
+                node = self._pick_prefix(directory, prompt, dead_ids)
             if node is None:
                 node = pick(self._pool_wait_s)
             if node is None:
@@ -1180,6 +1292,21 @@ class FleetBackend(Backend):
                 if op == "migrate.err":
                     # The node declined (pool pressure, bad transfer) but
                     # is healthy: retry elsewhere without fencing it.
+                    if not recover(False):
+                        return
+                    last_frame = time.monotonic()
+                    continue
+                if op == "fleet.handoff":
+                    # The node released this stream (fleet drain or
+                    # rebalance): the fresh checkpoint that preceded this
+                    # marker on the same queue re-homes it, seq dedup
+                    # keeps delivery exactly-once. Exclude the node
+                    # locally (no fence — it is healthy) so the re-pick
+                    # cannot bounce the stream straight back before the
+                    # draining heartbeat lands in the directory.
+                    self.metrics.counter("fleet_drained_sessions")
+                    if node is not None:
+                        dead_ids.add(node.get("node_id"))
                     if not recover(False):
                         return
                     last_frame = time.monotonic()

@@ -124,6 +124,22 @@ METRICS = {
     "tokens_deduped": ("counter", "Replayed tokens suppressed by seq dedup"),
     "stale_frames_fenced": ("counter", "Frames dropped from fenced attempts"),
     "mttr_ms": ("summary", "Death detection to first post-resume token"),
+    # elastic fleet controller (fleet/): drain / rebalance / autoscale
+    "fleet_drains": ("counter", "Drain operations issued to decode nodes"),
+    "fleet_drained_sessions": ("counter", "Streams re-homed by a drain handoff"),
+    "fleet_handoffs_sent": ("counter", "Session handoffs shipped by nodes"),
+    "fleet_rebalance_migrations": ("counter", "Sessions asked off hot nodes"),
+    "fleet_scale_out": ("counter", "Autoscaler pool-grow decisions"),
+    "fleet_scale_in": ("counter", "Autoscaler drain-then-fence decisions"),
+    "fleet_pool_size": ("gauge", "Live (non-draining) decode nodes at scrape"),
+    # bytes-vs-latency placement decisions (fleet/costmodel.py)
+    "fleet_query_moved": ("counter", "Placements routed to the prefix holder"),
+    "fleet_pages_fetched": ("counter", "Placements that shipped prefix pages"),
+    "fleet_migrated": ("counter", "Placements that recompute elsewhere"),
+    "fleet_pages_served": ("counter", "Prefix pages exported for a page-ship"),
+    "fleet_pages_imported": ("counter", "Shipped prefix pages installed"),
+    "fleet_page_ship_failed": ("counter", "Page-ships abandoned (cold fallback)"),
+    "fleet_page_ship_ms": ("summary", "Page-ship round trip wall time"),
 }
 
 

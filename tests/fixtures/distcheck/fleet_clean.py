@@ -1,0 +1,69 @@
+"""Annotated twin of ``fleet_violation.py`` — expects NO findings.
+
+The drain is acked (``fleet.ack``/``what=drain``) so the controller's
+poll has something to latch onto, and a failed page export answers the
+shipper with an error frame before bailing — both paths keep the reply
+guarantee the real ``disagg.decode_node.DecodeNode._consume`` loop
+honors for the fleet verbs. A ``ControllerStub`` closes the frame-key
+world: it produces the request keys the consumer reads and consumes
+the ack keys the consumer produces.
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import (
+    pack_frame,
+    unpack_frame,
+)
+
+
+class ControllerStub:
+    def __init__(self, relay):
+        self.relay = relay
+
+    def send_drain(self):
+        self.relay.put("decode.n1", pack_frame({
+            "op": "fleet.drain", "reply": "fleet.ctl.1",
+        }))
+
+    def send_pages(self, prompt):
+        self.relay.put("decode.n1", pack_frame({
+            "op": "fleet.pages", "reply": "fleet.ctl.1", "prompt": prompt,
+        }))
+
+    def on_ack(self, frame):
+        header, _ = unpack_frame(frame)
+        if not header.get("ok"):
+            return header.get("error")
+        return header.get("what"), header.get("n")
+
+
+class FleetConsumer:
+    def __init__(self, relay, engine, metrics):
+        self.relay = relay
+        self.engine = engine
+        self.metrics = metrics
+        self._stopped = False
+        self._draining = False
+
+    def _consume(self):
+        while not self._stopped:
+            try:
+                frame = self.relay.get("decode.n1", timeout=0.5)
+            except TimeoutError:
+                continue  # nothing consumed yet: exempt
+            header, _ = unpack_frame(frame)
+            op = header.get("op")
+            if op == "fleet.drain":
+                self._draining = True
+                self.relay.put(header.get("reply"), pack_frame({
+                    "op": "fleet.ack", "what": "drain", "ok": True, "n": 1,
+                }))
+                continue  # distcheck: reply-ok(drain acked to the controller)
+            if op == "fleet.pages":
+                try:
+                    self.engine.export_prefix_pages(header.get("prompt"))
+                except Exception as e:
+                    self.relay.put(header.get("reply"), pack_frame({
+                        "op": "fleet.ack", "what": "pages", "ok": False,
+                        "error": repr(e),
+                    }))
+                    return  # distcheck: reply-ok(nack answered the shipper)

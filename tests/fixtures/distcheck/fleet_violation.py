@@ -1,0 +1,41 @@
+"""Seeded reply-guarantee violations in a fleet-frame consumer —
+distcheck fixture.
+
+The consumer drains a decode node's op queue for the elastic-fleet
+verbs (``fleet.drain`` / ``fleet.pages``). The fleet controller (or a
+gateway shipping pages) is blocked on the reply queue after sending
+one: dropping the frame silently stalls the drain poll (the controller
+fences on a timeout instead of an ack) or strands the page ship —
+exactly the hang DC130 exists to catch.
+
+Expected findings:
+  DC130 x2  (drain absorbed without an ack; silent return when the
+             page export fails)
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import unpack_frame
+
+
+class FleetConsumer:
+    def __init__(self, relay, engine):
+        self.relay = relay
+        self.engine = engine
+        self._stopped = False
+        self._draining = False
+
+    def _consume(self):
+        while not self._stopped:
+            try:
+                frame = self.relay.get("decode.n1", timeout=0.5)
+            except TimeoutError:
+                continue  # nothing consumed yet: exempt
+            header, _ = unpack_frame(frame)
+            op = header.get("op")
+            if op == "fleet.drain":
+                self._draining = True
+                continue  # DC130: controller polls forever for an ack
+            if op == "fleet.pages":
+                try:
+                    self.engine.export_prefix_pages(header.get("prompt"))
+                except Exception:
+                    return  # DC130: shipper never hears the export died

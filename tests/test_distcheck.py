@@ -107,6 +107,10 @@ _EXPECTED = {
         "DC130": 2,  # migration consumer: silent unknown-op drop; silent
         #              return on failed admission (gateway left hanging)
     },
+    "fleet_violation.py": {
+        "DC130": 2,  # fleet consumer: drain absorbed without an ack;
+        #              silent return on a failed page export
+    },
 }
 
 
@@ -132,6 +136,7 @@ _CLEAN = [
     "lifecycle_clean.py",
     "reply_clean.py",
     "migrate_clean.py",
+    "fleet_clean.py",
 ]
 
 

@@ -53,6 +53,14 @@
 # regression over HTTP) are tier-1 and deliberately NOT marked 'slow':
 # they are the correctness gate for scheduler-ordered admission — the
 # byte-exactness cases are what licenses turning `--sched` on at all.
+# The elastic-fleet contract tests (tests/test_fleet.py, marked 'fleet'
+# + 'disagg': cost-model crossovers + decision counters, draining-row
+# policy, prefix page-ship round trips, autoscale hysteresis, and the
+# live drain/rebalance/crash-racing-drain byte-exactness e2e over the
+# native relay) are deliberately NOT marked 'slow': they are the
+# correctness gate for zero-loss pool reshapes — the drain e2e combos
+# are the licence for fencing a live node at all. They ride the disagg
+# block at the end of the schedule (~90 s of the budget on CPU).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
