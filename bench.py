@@ -569,6 +569,9 @@ PHASES = {
     "disagg": None,
     # Prefill compute (TFLOP/s at prompt 128/512/2048) — _prefill_phase().
     "prefill": None,
+    # Mixed-phase serving: decode ITL p50/p99 while a long prompt is admitted
+    # monolithically vs chunked through the ragged plan — _mixed_phase().
+    "mixed": None,
 }
 
 # Phases that skip the (redundant) prompt-128 TTFT measurement to bound
@@ -1193,6 +1196,148 @@ def _prefill_phase() -> dict:
             }
         else:
             out[f"prompt_{S}"] = {"device_ms_min": None}
+    out["engine_decode_sweep"] = _ragged_engine_sweep(
+        cfg, params, (128, 512, 1024, 2048) if on_tpu else (16,),
+        batch=8 if on_tpu else 4,
+    )
+    return out
+
+
+def _ragged_engine_sweep(cfg, params, contexts, batch=8, ticks=4) -> dict:
+    """Per-context engine decode: bucketed vs ragged dispatch (the
+    AttentionPlan, engine/plan.py). Mixed prompt LENGTHS per batch so the
+    legacy path pays its bucket tax — one executable per (bucket,
+    row-count) pair — while ragged mode pads every prefill-family dispatch
+    to one width. Reports tok/s plus attn_recompiles split into warm
+    (expected: the finite executable set) and steady (expected 0 for
+    ragged — the zero-recompile-after-warmup contract)."""
+    from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
+    from distributed_llm_inference_tpu.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    on_tpu = jax.default_backend() == "tpu"
+    warm, k = 3, 16
+    out = {}
+    for ctx in contexts:
+        max_seq = ((ctx + 1 + (warm + ticks) * k + 31) // 32) * 32
+        ps = 64
+        slots = -(-max_seq // ps)
+        buckets = tuple(sorted({max(8, ctx // 4), max(8, ctx // 2), ctx}))
+        # Length spread across the buckets: this is the traffic shape the
+        # bucketed path recompiles on.
+        lens = [
+            max(4, ctx - (i * ctx) // (2 * batch)) for i in range(batch)
+        ]
+        row = {}
+        for label, ragged in (("bucketed", False), ("ragged", True)):
+            eng = InferenceEngine(
+                cfg, params,
+                EngineConfig(
+                    max_batch_size=batch, max_seq_len=max_seq,
+                    prefill_buckets=buckets, decode_windows=(),
+                    ragged_attention=ragged,
+                    dtype="bfloat16" if on_tpu else "float32",
+                ),
+                CacheConfig(
+                    kind="paged", kv_quant="int8", page_size=ps,
+                    num_pages=batch * slots + 1, max_pages_per_session=slots,
+                ),
+            )
+            opts = SamplingOptions(max_new_tokens=1_000_000, eos_token_id=-1)
+            for n in lens:
+                eng.submit([1] * n, opts)
+            for _ in range(warm):
+                eng.step()
+            seen = eng.metrics.get_counter("attn_recompiles")
+            t0 = time.perf_counter()
+            delivered = 0
+            for _ in range(ticks):
+                for _, tok, _f in eng.step():
+                    if tok != -1:
+                        delivered += 1
+            dt = time.perf_counter() - t0
+            row[label] = {
+                "tok_s": round(delivered / dt, 1),
+                "attn_recompiles_warm": int(seen),
+                "attn_recompiles_steady": int(
+                    eng.metrics.get_counter("attn_recompiles") - seen
+                ),
+            }
+        out[f"ctx_{ctx}"] = row
+    return out
+
+
+def _mixed_phase() -> dict:
+    """Resident ITL while a LONG prompt lands mid-decode (the chunked-
+    prefill co-scheduling satellite): with the legacy monolithic path the
+    admitting tick stalls every resident stream behind one full-prompt
+    prefill; with ragged co-scheduling (``chunk_decode_share``) the prompt
+    walks in ``prefill_chunk_tokens`` chunks beside decode. Reports the
+    per-step interval p50/p99 over the admission window for both modes,
+    plus the long prompt's TTFT (chunking trades its TTFT for resident
+    tail latency)."""
+    from distributed_llm_inference_tpu.config import CacheConfig, EngineConfig
+    from distributed_llm_inference_tpu.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA3_8B if on_tpu else TINY
+    params = _zero_qparams(cfg, jnp.bfloat16 if on_tpu else jnp.float32)
+    jax.block_until_ready(params)
+    batch = 8 if on_tpu else 4
+    short = 128 if on_tpu else 8
+    longp = 2048 if on_tpu else 48
+    steps = (longp // short) + 12
+    ps = 64
+    max_seq = ((longp + 1 + (steps + 4) * 16 + 31) // 32) * 32
+    slots = -(-max_seq // ps)
+    out = {
+        "model": "llama-3-8b-shape" if on_tpu else "tiny-cpu-fallback",
+        "backend": jax.default_backend(),
+        "scope": f"{batch - 1} residents (prompt {short}) + one prompt-"
+                 f"{longp} admission; per-step interval over {steps} steps",
+    }
+    for label, (ragged, share) in (
+        ("monolithic", (False, 0.0)), ("chunked", (True, 0.5)),
+    ):
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(
+                max_batch_size=batch, max_seq_len=max_seq,
+                prefill_buckets=(short, longp), decode_windows=(),
+                ragged_attention=ragged, prefill_chunk_tokens=short,
+                chunk_decode_share=share,
+                dtype="bfloat16" if on_tpu else "float32",
+            ),
+            CacheConfig(
+                kind="paged", kv_quant="int8", page_size=ps,
+                num_pages=batch * slots + 1, max_pages_per_session=slots,
+            ),
+        )
+        opts = SamplingOptions(max_new_tokens=1_000_000, eos_token_id=-1)
+        for _ in range(batch - 1):
+            eng.submit([1] * short, opts)
+        for _ in range(4):  # admit + compile + steady state
+            eng.step()
+        t_submit = time.perf_counter()
+        gid = eng.submit([2] * longp, opts)
+        itls, ttft = [], None
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            evs = eng.step()
+            itls.append((time.perf_counter() - t0) * 1e3)
+            if ttft is None and any(
+                g == gid and tok != -1 for g, tok, _f in evs
+            ):
+                ttft = (time.perf_counter() - t_submit) * 1e3
+        out[label] = {
+            "itl_ms_p50": round(float(np.percentile(itls, 50)), 2),
+            "itl_ms_p99": round(float(np.percentile(itls, 99)), 2),
+            "long_ttft_ms": round(ttft, 1) if ttft is not None else None,
+            "attn_chunked_rows": int(
+                eng.metrics.get_counter("attn_chunked_rows")
+            ),
+        }
     return out
 
 
@@ -2379,6 +2524,8 @@ def run_phase(name: str) -> dict:
         return _elastic_phase()
     if name == "prefill":
         return _prefill_phase()
+    if name == "mixed":
+        return _mixed_phase()
     on_tpu = jax.default_backend() == "tpu"
     cfg, model_label = _PHASE_CFG.get(name, (LLAMA2_7B, "llama-2-7b-shape"))
     if not on_tpu:
@@ -2510,7 +2657,7 @@ def main():
     # reads a bounded window — neither is comparable decode work.
     _NON_HEADLINE = {"speculative", "sink_1k", "llama3_8b_int8_kvq",
                      "mistral_paged_swa", "mixtral", "distributed",
-                     "disagg", "prefill"}
+                     "disagg", "prefill", "mixed"}
     best_dtype = max(
         (n for n in results if n not in _NON_HEADLINE),
         key=lambda n: results[n]["tok_s"],

@@ -109,6 +109,12 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     # Use the Pallas paged-attention kernel for decode steps (reads pages in
     # place instead of gathering a contiguous per-row view).
     use_kernel: bool = struct.field(pytree_node=False, default=False)
+    # Serve multi-token rows (prefill / chunked prefill) through the ragged
+    # mixed-phase kernel (ops/ragged_attention.py) — pages read in place
+    # with per-row true lengths, replacing update_and_gather's contiguous
+    # [B, max_len, Hkv, D] copy. Set by the engine's AttentionPlan (TPU
+    # only; interpret mode is test-grade).
+    use_ragged: bool = struct.field(pytree_node=False, default=False)
 
     # Generic-consumer layout (see DenseKVCache): the page pool is batch-free;
     # only the table/lengths have session rows. Pool fields carry the layer
@@ -131,6 +137,7 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
         head_dim: int,
         dtype=jnp.bfloat16,
         use_kernel: bool = False,
+        use_ragged: bool = False,
     ) -> "PagedKVCache":
         shape = (num_layers, num_pages, num_kv_heads, page_size, head_dim)
         return PagedKVCache(
@@ -140,6 +147,7 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
             lengths=jnp.zeros((batch,), jnp.int32),
             page_size=page_size,
             use_kernel=use_kernel,
+            use_ragged=use_ragged,
         )
 
     @property
@@ -246,8 +254,25 @@ class PagedKVCache(GatherAttendMixin, struct.PyTreeNode):
     ):
         """Decode steps with ``use_kernel``: scatter into the pool, then run
         the Pallas paged kernel over the pages in place — no contiguous
-        gather. Prefill (S>1) and the non-kernel path use the default
-        gather+``attention_fn`` (``GatherAttendMixin``)."""
+        gather. Multi-token rows with ``use_ragged`` go through the ragged
+        mixed-phase kernel the same way (per-row true lengths, phase is
+        data). Everything else uses the default gather+``attention_fn``
+        (``GatherAttendMixin``)."""
+        if self.use_ragged and q.shape[1] > 1:
+            from ..ops.ragged_attention import ragged_paged_attention
+
+            layer_k, layer_v = layer_state
+            q_rot = apply_rope(q, rope.cos, rope.sin)
+            k_rot = apply_rope(k_new, rope.cos, rope.sin)
+            new_k, new_v = self._scatter(
+                layer_k, layer_v, k_rot, v_new, q_pos, num_new
+            )
+            out = ragged_paged_attention(
+                q_rot, new_k, new_v, self.page_table,
+                self.lengths + num_new, num_new,
+                scale=scale, sliding_window=sliding_window,
+            )
+            return out, (new_k, new_v)
         if not self.use_kernel or q.shape[1] != 1:
             return super().attend(
                 layer_state, q, k_new, v_new, rope, q_pos, num_new,
@@ -777,6 +802,7 @@ class QuantizedPagedKVCache(PagedKVCache):
         head_dim: int,
         dtype=jnp.bfloat16,  # interface parity; values are int8
         use_kernel: bool = False,
+        use_ragged: bool = False,
     ) -> "QuantizedPagedKVCache":
         shape = (num_layers, num_pages, num_kv_heads, page_size, head_dim)
         return QuantizedPagedKVCache(
@@ -788,6 +814,7 @@ class QuantizedPagedKVCache(PagedKVCache):
             lengths=jnp.zeros((batch,), jnp.int32),
             page_size=page_size,
             use_kernel=use_kernel,
+            use_ragged=use_ragged,
         )
 
     @property
@@ -896,6 +923,23 @@ class QuantizedPagedKVCache(PagedKVCache):
 
     def attend(self, layer_state, q, k_new, v_new, rope, q_pos, num_new,
                sliding_window, attention_fn, scale=None):
+        if self.use_ragged and q.shape[1] > 1:
+            from ..ops.ragged_attention import (
+                quantized_ragged_paged_attention,
+            )
+
+            lk, lv, lks, lvs = layer_state
+            q_rot = apply_rope(q, rope.cos, rope.sin)
+            k_rot = apply_rope(k_new, rope.cos, rope.sin)
+            new = self._scatter_q(
+                lk, lv, lks, lvs, k_rot, v_new, q_pos, num_new
+            )
+            out = quantized_ragged_paged_attention(
+                q_rot, new[0], new[2], new[1], new[3], self.page_table,
+                self.lengths + num_new, num_new,
+                scale=scale, sliding_window=sliding_window,
+            )
+            return out, new
         if not self.use_kernel or q.shape[1] != 1:
             # Long prefill: flash over the dequantized pool view (see
             # cache/base.py flash_prefill_fn — the full-score path

@@ -191,6 +191,27 @@ class EngineConfig:
     # variant wins at MHA b64 but loses at small-batch GQA, and CPU tests
     # would crawl through interpret mode.
     use_pallas_attention: Optional[bool] = None
+    # Ragged mixed-phase attention (engine/plan.py + ops/ragged_attention.py):
+    # prefill-family dispatches pad to ONE width (prefill_chunk_tokens) so
+    # mixed-length traffic stops recompiling per bucket, paged caches on TPU
+    # serve multi-token rows through the ragged Pallas kernel (pages read in
+    # place — no contiguous gather copy), and long GREEDY prompts co-schedule
+    # chunked prefill with live decode ticks. Token streams are byte-exact
+    # with the flag on or off (the legacy admission partition and PRNG key
+    # order are preserved; only pad widths change). None (default) = auto:
+    # ON for paged caches on a real TPU backend, OFF elsewhere (CPU keeps
+    # the legacy bucketed default; tests opt in explicitly).
+    ragged_attention: Optional[bool] = None
+    # Token width of one chunked-prefill dispatch under ragged mode — also
+    # THE single prefill pad width (capped at the legacy chunk cap so chunk
+    # boundaries match the legacy path). None = the largest prefill bucket.
+    prefill_chunk_tokens: Optional[int] = None
+    # Fraction of decode ticks that may also carry a chunked-prefill
+    # dispatch when long-prompt admission rides the decode cadence (credit
+    # accumulator; 1.0 = every tick, 0 = never co-schedule — long prompts
+    # fall back to standalone prefill). With no live decode rows chunks
+    # stream at full speed regardless.
+    chunk_decode_share: float = 0.5
     # Tokens decoded per device dispatch (lax.scan over the decode step with
     # sampling, EOS and per-row token budgets all in-graph). Each host→device
     # round trip costs ~50 ms through the tunnel at 7B shapes — far more than

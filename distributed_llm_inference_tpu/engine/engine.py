@@ -46,6 +46,7 @@ from ..config import CacheConfig, EngineConfig, ModelConfig, PrefixConfig
 from ..models import llama
 from ..utils.metrics import Metrics
 from ..utils.tracing import SpanRecorder, span
+from .plan import AttentionPlan
 from .sampling import SamplingOptions, SamplingParams, sample
 from .session import Session, SessionState
 
@@ -152,22 +153,25 @@ class InferenceEngine:
         self.batch = self.ecfg.max_batch_size
         dtype = jnp.dtype(self.ecfg.dtype)
         b, cc = self.batch, self.ccfg
-        # use_pallas_attention=None resolves to: ON for the int8 DENSE cache
-        # on a real TPU backend (the fused kernel measured +40% through the
-        # engine at the headline config), OFF elsewhere — the paged pool's
-        # gathered variant WINS at MHA batch 64 but LOSES at small-batch GQA
-        # (Mistral b32: 1709 vs 1860 raw), so paged serving keeps the XLA
-        # two-segment path unless the caller opts in; CPU runs kernels in
-        # interpret mode (correct but orders of magnitude slower).
-        self._use_pallas = (
-            self.ecfg.use_pallas_attention
-            if self.ecfg.use_pallas_attention is not None
-            else (
-                jax.default_backend() == "tpu"
-                and cc.kind in ("dense", "sink")
-                and cc.kv_quant == "int8"
-            )
-        )
+        # Dispatch-shape and kernel policy is owned by the AttentionPlan
+        # (engine/plan.py): it resolves use_pallas_attention's auto rule
+        # (unchanged: ON for the int8 DENSE cache on a real TPU, where the
+        # fused kernel measured +40% through the engine; the paged pool's
+        # gathered variant WINS at MHA batch 64 but LOSES at small-batch
+        # GQA, so paged DECODE keeps the XLA two-segment path), routes
+        # paged multi-token rows through the ragged mixed-phase kernel on
+        # TPU, and owns every prefill-family pad width below.
+        self.plan = AttentionPlan(self.ecfg, self.ccfg, metrics=self.metrics)
+        if mesh_cfg is not None:
+            # Mesh engines keep the legacy path end to end: ring/sp prefill
+            # is a different collective-bearing program and the ragged
+            # kernel is single-device.
+            self.plan.enabled = False
+        _sel = self.plan.select()
+        self._use_pallas = _sel.use_pallas
+        # Sessions parked mid chunked-prefill (slot held, decode-ineligible;
+        # advanced by _chunk_dispatch on the decode cadence).
+        self._chunking: List[Session] = []
         self._windows: Tuple[int, ...] = ()
         # prefixstore state: host spill arena (paged + prefix_caching +
         # spill budget only) and the cumulative prompt-token reuse ratio
@@ -239,6 +243,7 @@ class InferenceEngine:
                 cfg.num_layers, b, cc.num_pages, cc.page_size,
                 self._first_slots, cfg.num_kv_heads, cfg.head_dim, dtype,
                 use_kernel=self._use_pallas,
+                use_ragged=_sel.use_ragged,
             )
             self.allocator = PageAllocator(cc.num_pages)
             if cc.prefix_caching and self.pcfg.spill_bytes_max > 0:
@@ -1000,17 +1005,16 @@ class InferenceEngine:
                 # Both batched-install pad buckets (_flush_installs) —
                 # mesh engines never dispatch these (their installs stay
                 # on the chained per-page path), so don't compile them.
-                for pad in {4, self._install_bucket()}:
+                for pad in set(self._install_pads()):
                     self.cache.assign_pages_batch([0], [0], [0], pad_to=pad)
 
-    def _install_bucket(self) -> int:
-        """Large flush-pad bucket: covers a growth tick (<= one install per
-        row) and any admission's prompt pages in one cached executable."""
-        n = max(self.batch, self.ccfg.max_pages_per_session)
-        pad = 4
-        while pad < n:
-            pad *= 2
-        return pad
+    def _install_pads(self) -> Tuple[int, int]:
+        """(small, large) flush-pad buckets, owned by the plan: the large
+        one covers a growth tick (<= one install per row) and any
+        admission's prompt pages in one cached executable."""
+        return self.plan.install_pads(
+            self.batch, self.ccfg.max_pages_per_session
+        )
 
     def _queue_install(self, row: int, slot_idx: int, page: int) -> None:
         """Defer a page-table install; :meth:`_flush_installs` applies every
@@ -1063,9 +1067,9 @@ class InferenceEngine:
         # than the big bucket (growth tick + oversized admission backlog in
         # one tick) splits into bucket-sized chunks — each a warmed
         # executable — instead of silently compiling an unwarmed length.
-        big = self._install_bucket()
+        small, big = self._install_pads()
         while rows:
-            n = 4 if len(rows) <= 4 else big
+            n = small if len(rows) <= small else big
             self.cache = self.cache.assign_pages_batch(
                 rows[:n], slots_[:n], pages[:n], pad_to=n
             )
@@ -1174,10 +1178,19 @@ class InferenceEngine:
                 prev = self._pending
                 self._pending = self._dispatch_tick(produced, prev)
                 self._resolve_pending(produced, prev)
+                # Chunked-prefill co-scheduling rides BEHIND the decode
+                # dispatch (device-ordered after it) and after the resolve,
+                # so a final chunk's deferred first token rides the NEXT
+                # tick's device_get exactly like an overlapped admission.
+                self._chunk_dispatch(produced)
                 self._admit(produced)
             else:
                 self._admit(produced)
-                if any(slot is not None for slot in self.slots):
+                self._chunk_dispatch(produced)
+                if any(
+                    gid is not None and not self.sessions[gid].chunking
+                    for gid in self.slots
+                ):
                     self._decode_tick(produced)
                 elif (
                     self.draft is not None
@@ -1892,10 +1905,9 @@ class InferenceEngine:
         return k
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.ecfg.prefill_buckets:
-            if n <= b:
-                return b
-        return self.ecfg.prefill_buckets[-1]
+        # Still the admission-partition key in ragged mode (plan docstring:
+        # partition == PRNG key order), even though pad widths differ.
+        return self.plan.bucket_for(n)
 
     def _max_chunk(self) -> int:
         """Largest prefill chunk the cache accepts (sink ring constraint)."""
@@ -2012,6 +2024,20 @@ class InferenceEngine:
                     {id(x) for x in ordered} == {id(x) for x in candidates}
                 ):
                     candidates = ordered
+        if candidates and free_slots:
+            # ONE capacity widen for the whole admission burst (the
+            # per-session _ensure_capacity below then no-ops). An oversized
+            # backlog landing on the same tick as a growth otherwise walks
+            # the ladder one rung per admitted session — each rung a table
+            # widen plus a _warm_table_write recompile, observed as
+            # back-to-back cache_growths inside one _admit.
+            needs = [
+                len(c.prompt) + 1
+                for c in candidates[: len(free_slots)]
+                if self._capacity_ok(c)
+            ]
+            if needs:
+                self._ensure_capacity(max(needs))
         ci = 0
         for slot in free_slots:
             if ci >= len(candidates):
@@ -2144,6 +2170,13 @@ class InferenceEngine:
                 self._prefill_group(group[:8], bucket, produced)
                 group = group[8:]
         for s, skip in singles:
+            # Long greedy prompts may park for chunk/decode co-scheduling
+            # instead of a monolithic synchronous prefill; _chunk_admit
+            # draws the session's PRNG key HERE — the same stream position
+            # the synchronous path would consume — so parking never
+            # perturbs the engine's key order.
+            if self._chunk_admit(s, skip):
+                continue
             self._run_prefill(s, produced, skip=skip)
 
     def _overlap_ok(self) -> bool:
@@ -2212,7 +2245,12 @@ class InferenceEngine:
         # with stale pre-prefill content).
         rows = np.full((nr,), self.batch, np.int32)
         n_valid = np.zeros((nr,), np.int32)
-        tokens = np.zeros((nr, bucket), np.int32)
+        # Ragged mode pads every group to ONE width per row count (the
+        # group keeps its bucket-keyed MEMBERSHIP — that is the PRNG-key
+        # partition — only the pad width changes, which parity is
+        # invariant to).
+        width = self.plan.group_shape(bucket, self._max_chunk())
+        tokens = np.zeros((nr, width), np.int32)
         opts = [SamplingOptions()] * nr
         for i, s in enumerate(group):
             rows[i] = s.slot
@@ -2220,6 +2258,7 @@ class InferenceEngine:
             tokens[i, : len(s.prompt)] = s.prompt
             opts[i] = s.options
         sp = SamplingParams.stack(opts)
+        self.plan.note_dispatch("prefill", (nr, width), int(n_valid.sum()))
         with self.metrics.timer("prefill"), span(
             "prefill_batch", self.spans, sessions=k,
             prompt_tokens=int(n_valid.sum()),
@@ -2352,21 +2391,24 @@ class InferenceEngine:
             self._finish_prefill(s, int(token), prompt, produced, skip)
             return
         offset = skip
+        stride = self.plan.prefill_stride(chunk_cap)
         with self.metrics.timer("prefill"), span(
             "prefill", self.spans,
             generation_id=s.generation_id, prompt_tokens=len(s.prompt),
         ):
-            while len(prompt) - offset > chunk_cap:
-                chunk = prompt[offset : offset + chunk_cap]
+            while len(prompt) - offset > stride:
+                chunk = prompt[offset : offset + stride]
                 padded = jnp.asarray(chunk)[None, :]
+                self.plan.note_dispatch("chunk", (1, stride), len(chunk))
                 self.cache = self._prefill_ns(
                     self.params, padded, self.cache, s.slot, jnp.int32(len(chunk))
                 )
-                offset += chunk_cap
+                offset += stride
             rest = prompt[offset:]
-            bucket = self._bucket_for(len(rest))
-            padded = np.zeros((1, bucket), np.int32)
+            width = self.plan.final_shape(len(rest), chunk_cap)
+            padded = np.zeros((1, width), np.int32)
             padded[0, : len(rest)] = rest
+            self.plan.note_dispatch("prefill", (1, width), len(rest))
             token, self.cache = self._prefill(
                 self.params, jnp.asarray(padded), self.cache, s.slot,
                 jnp.int32(len(rest)), self._next_key(), sp,
@@ -2379,6 +2421,133 @@ class InferenceEngine:
             return
         self.metrics.counter("admit_sync_sessions")
         self._finish_prefill(s, int(token), prompt, produced, skip)
+
+    def _chunk_admit(self, s: Session, skip: int) -> bool:
+        """Park an admitted long GREEDY prompt for chunk/decode
+        co-scheduling instead of a monolithic synchronous prefill: the
+        session holds its slot (decode-ineligible) while _chunk_dispatch
+        walks the prompt one ``plan.prefill_stride`` chunk per granted
+        tick beside the live decode batch. Returns False — caller runs
+        the legacy path — unless eligible (ragged mode on, greedy, long
+        enough, single-device, no draft, no ring path, and at least one
+        OTHER live row to ride beside; alone, the standalone prefill is
+        strictly better for TTFT)."""
+        if self.mesh is not None or self.draft is not None:
+            return False
+        if not self.plan.co_schedule_ok(
+            len(s.prompt) - skip, s.options.temperature, self._max_chunk()
+        ):
+            return False
+        if (
+            self._ring_prefill is not None
+            and skip == 0
+            and len(s.prompt) > self._ring_threshold()
+        ):
+            return False
+        if self.ccfg.prefix_caching and self.pcfg.prefix_share:
+            # Register-at-admission already made this prompt's pages
+            # shareable; stretching the writes over ticks would let a later
+            # admission attach to pages whose KV isn't written yet. Keep
+            # the synchronous path (its writer-before-sharer dispatch
+            # ordering is what makes register-at-admission safe).
+            return False
+        # Park only when another row is decode-LIVE (first token already
+        # sampled — a same-tick co-admission that has not prefilled yet
+        # does not count): alone, the standalone prefill is strictly
+        # better for TTFT, and there is no decode stream to protect.
+        others = any(
+            gid is not None
+            and gid != s.generation_id
+            and not self.sessions[gid].chunking
+            and self.sessions[gid].generated
+            for gid in self.slots
+        )
+        if not others:
+            return False
+        if s.cow_src is not None:
+            # Deferred CoW split (see _run_prefill): enqueue the device
+            # copy before any chunk writes through this row.
+            ps = self.ccfg.page_size
+            self.cache = self.cache.copy_page(s.pages[skip // ps], s.cow_src)
+            self.allocator.free([s.cow_src])
+            s.cow_src = None
+        s.chunking = True
+        s.chunk_off = skip
+        s.chunk_skip = skip
+        # Draw the admission key NOW — the stream position the synchronous
+        # prefill would have consumed — and park it for the final chunk's
+        # sample, so co-scheduling never perturbs the engine's key order
+        # (byte-exact parity with the legacy path).
+        s.parked_key = self._next_key()
+        self._chunking.append(s)
+        return True
+
+    def _chunk_dispatch(self, produced) -> None:
+        """Advance co-scheduled chunked prefills by one chunk per granted
+        tick (``plan.take_chunk_credit`` rations grants at
+        ``chunk_decode_share`` against live decode; full speed when no
+        decode rows remain). Interior chunks are keyless cache writes —
+        the exact ``_prefill_ns`` program the legacy chunk loop runs — and
+        the final chunk samples the first token with the session's parked
+        admission key, entering decode via the overlap machinery when
+        available."""
+        if not self._chunking:
+            return
+        decode_active = any(
+            gid is not None and not self.sessions[gid].chunking
+            for gid in self.slots
+        )
+        if not self.plan.take_chunk_credit(decode_active):
+            return
+        chunk_cap = self._max_chunk()
+        stride = self.plan.prefill_stride(chunk_cap)
+        for s in list(self._chunking):
+            if s.state is not SessionState.ACTIVE or s.slot is None:
+                # A cancel/deadline reap already released the row (and
+                # cleared the chunking flags) — just drop the parked entry.
+                if s in self._chunking:
+                    self._chunking.remove(s)
+                continue
+            self._flush_installs()  # chunk writes go through the table
+            prompt = np.asarray(s.prompt, np.int32)
+            rest = len(prompt) - s.chunk_off
+            if rest > stride:
+                chunk = prompt[s.chunk_off : s.chunk_off + stride]
+                self.plan.note_dispatch("chunk", (1, stride), len(chunk))
+                with self.metrics.timer("prefill"):
+                    self.cache = self._prefill_ns(
+                        self.params, jnp.asarray(chunk)[None, :],
+                        self.cache, s.slot, jnp.int32(len(chunk)),
+                    )
+                s.chunk_off += stride
+                self.plan.note_chunk_rows()
+                continue
+            width = self.plan.final_shape(rest, chunk_cap)
+            padded = np.zeros((1, width), np.int32)
+            padded[0, :rest] = prompt[s.chunk_off :]
+            sp = SamplingParams.create(
+                1, s.options.temperature, s.options.top_k, s.options.top_p
+            )
+            self.plan.note_dispatch("prefill", (1, width), rest)
+            with self.metrics.timer("prefill"):
+                token, self.cache = self._prefill(
+                    self.params, jnp.asarray(padded), self.cache, s.slot,
+                    jnp.int32(rest), s.parked_key, sp,
+                )
+            self.plan.note_chunk_rows()
+            s.chunking = False
+            s.parked_key = None
+            self._chunking.remove(s)
+            if self._overlap_ok():
+                self._defer_admit(
+                    [s], token, np.asarray([s.slot], np.int32),
+                    [s.chunk_skip],
+                )
+                continue
+            self.metrics.counter("admit_sync_sessions")
+            # distcheck: host-sync-ok(final-chunk first-token fetch — the same one-per-admission sync the legacy _run_prefill path pays)
+            tok = int(np.asarray(jax.device_get(token)))
+            self._finish_prefill(s, tok, prompt, produced, s.chunk_skip)
 
     def _finish_prefill(self, s, token, prompt, produced, skip):
         self._deliver(s, int(token), produced)
@@ -2605,6 +2774,11 @@ class InferenceEngine:
             if gid is None:
                 continue
             s = self.sessions[gid]
+            if s.chunking:
+                # Mid chunked-prefill: the row holds its slot (pages, table)
+                # but is not decode-eligible until the final chunk samples
+                # its first token — budget stays 0 so the mask excludes it.
+                continue
             opts[slot] = s.options
             fresh[slot, 0] = s.last_token
             use_carry[slot] = self._carry_ok[slot]
@@ -2654,6 +2828,11 @@ class InferenceEngine:
             )
         act_dev = jnp.asarray(active)
         self._flush_installs()
+        self.plan.note_dispatch("decode", (
+            self.batch, K,
+            self.cache.page_table.shape[1] if paged
+            else int(getattr(self.cache, "max_len", 0)),
+        ))
         with self.metrics.timer("decode_step"), span(
             "decode_step", self.spans, batch=int(active.sum()),
         ):
@@ -2752,6 +2931,8 @@ class InferenceEngine:
             if gid is None:
                 continue
             s = self.sessions[gid]
+            if s.chunking:  # mid chunked-prefill: not decode-eligible
+                continue
             tokens[slot, 0] = s.last_token
             opts[slot] = s.options
 
@@ -2765,6 +2946,8 @@ class InferenceEngine:
                 if gid is None:
                     continue
                 s = self.sessions[gid]
+                if s.chunking:
+                    continue
                 want = min(K, s.options.max_new_tokens - len(s.generated))
                 cap = self._grow_pages_for(s, want, produced)
                 if cap is None:
@@ -2775,6 +2958,8 @@ class InferenceEngine:
                 if gid is None:
                     continue
                 s = self.sessions[gid]
+                if s.chunking:
+                    continue
                 if s.total_len + 1 > self.ecfg.max_seq_len:
                     self._finish(s, "capacity", produced)
                     continue
@@ -2789,6 +2974,8 @@ class InferenceEngine:
                 if gid is None:
                     continue
                 s = self.sessions[gid]
+                if s.chunking:
+                    continue
                 if s.total_len + 1 > cap:
                     self._finish(s, "capacity", produced)
                     continue
@@ -2797,8 +2984,16 @@ class InferenceEngine:
                     cap - s.total_len,
                 )
 
+        # Chunking rows hold slots but must NOT be decode-written (their
+        # rows are mid-prefill; a decode write would land at the chunk
+        # offset and corrupt the prompt KV).
         active = np.array(
-            [self.slots[i] is not None for i in range(self.batch)], np.bool_
+            [
+                self.slots[i] is not None
+                and not self.sessions[self.slots[i]].chunking
+                for i in range(self.batch)
+            ],
+            np.bool_,
         )
         if not active.any():
             return
@@ -2811,6 +3006,12 @@ class InferenceEngine:
 
         sp = SamplingParams.stack(opts)
         self._flush_installs()
+        self.plan.note_dispatch("decode", (
+            self.batch, K,
+            self.cache.page_table.shape[1]
+            if isinstance(self.cache, PagedKVCache)
+            else int(getattr(self.cache, "max_len", 0)),
+        ))
         with self.metrics.timer("decode_step"), span(
             "decode_step", self.spans, batch=int(active.sum()),
         ):
@@ -3235,6 +3436,15 @@ class InferenceEngine:
         self.metrics.counter("sessions_finished")
 
     def _release(self, s: Session) -> None:
+        # A session reaped mid chunked-prefill has INCOMPLETE prompt KV
+        # (only chunk_off tokens written): its pages must not be registered
+        # as shareable prefix content below.
+        partial = s.chunking
+        if partial:
+            s.chunking = False
+            s.parked_key = None
+        if s in self._chunking:
+            self._chunking.remove(s)
         if s.slot is not None:
             self.slots[s.slot] = None
             # The device carry holds THIS session's last token; the slot's
@@ -3249,7 +3459,7 @@ class InferenceEngine:
             self.allocator.free([s.cow_src])
             s.cow_src = None
         if isinstance(self.cache, PagedKVCache) and s.pages:
-            if self.ccfg.prefix_caching:
+            if self.ccfg.prefix_caching and not partial:
                 # Content-address the pages fully covered by PROMPT tokens so
                 # later sessions with the same prefix reuse their KV. Pages
                 # touching generated tokens are position-pure too, but their

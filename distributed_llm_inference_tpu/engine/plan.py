@@ -1,0 +1,247 @@
+"""AttentionPlan: one owner for dispatch shapes, phases, and kernel choice.
+
+Before this module the shape policy lived in four places that had to agree
+by convention: ``engine.py:_bucket_for`` picked prompt buckets, the admission
+paths padded to them, ``_install_bucket``/``_flush_installs`` kept their own
+pad set for page-table scatters, and ``__init__`` resolved which attention
+kernel each cache kind got. Every consumer compiled its own executable per
+shape, so mixed-length traffic paid one recompile per (bucket, row-count)
+pair — the "bucket tax" BENCH_r05 measured at 23–28% of nominal prefill
+TFLOP/s.
+
+The plan centralizes that policy:
+
+* **Row classification & shapes.** A prompt is a PREFILL row (fits one
+  dispatch), a CHUNKED-PREFILL row (walks the prompt ``chunk_tokens`` at a
+  time), or a DECODE row. In ragged mode every prefill-family dispatch pads
+  to ONE token width (``chunk_tokens``, default the largest bucket), so the
+  warm executable set is finite and mixed lengths stop recompiling.
+* **Partition preservation.** Ragged mode deliberately keeps the LEGACY
+  admission partition — group membership via :meth:`bucket_for` and the
+  legacy chunk cap — and changes only the padded dispatch widths. The
+  engine draws one PRNG key per admission group/single in admission order;
+  keeping the partition keeps the key sequence, which is what makes ragged
+  on/off byte-exact for sampled decoding, not just greedy (the sampling
+  noise depends on the key and row count, never on pad width).
+* **Kernel selection.** Resolves ``use_pallas_attention`` (cache-owned
+  decode kernels) and the ragged paged kernel (``ops/ragged_attention.py``)
+  from one place; the paged cache reads the decision via its
+  ``use_kernel``/``use_ragged`` fields.
+* **Chunk/decode co-scheduling budget.** A fractional credit accumulator
+  (``chunk_decode_share``) rations how many decode ticks also carry a
+  chunked-prefill dispatch, so admission of a long prompt stretches over
+  ticks instead of stalling the decode batch behind one monolithic prefill.
+* **Dispatch telemetry.** Every dispatch funnels through
+  :meth:`note_dispatch`, which maintains the seen-shape set behind the
+  ``attn_recompiles`` counter (a first-seen (kind, shape) is exactly one
+  fresh XLA executable), counts ``attn_ragged_dispatches`` /
+  ``attn_chunked_rows``, and publishes ``attn_grid_occupancy`` (valid /
+  padded token fraction of the latest prefill-family dispatch).
+
+This is also the fusion point ROADMAP item 4 (batched spec verification)
+needs: a verify row is just one more ``num_new == k`` row class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+
+__all__ = ["AttentionPlan", "KernelSelection", "PREFILL", "CHUNKED", "DECODE"]
+
+# Row phases (data, not shape: the ragged kernel serves all three in one
+# grid call — see ops/ragged_attention.py).
+PREFILL = "prefill"
+CHUNKED = "chunked_prefill"
+DECODE = "decode"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSelection:
+    """Resolved kernel routing for one engine instance.
+
+    ``use_pallas``: cache-owned Pallas decode kernels (``use_kernel=`` on
+    the cache; also gates the flash prefill swap in ``__init__``).
+    ``use_ragged``: paged caches serve multi-token rows through the ragged
+    mixed-phase kernel instead of the contiguous ``update_and_gather`` copy.
+    """
+
+    use_pallas: bool
+    use_ragged: bool
+
+
+class AttentionPlan:
+    """Owns dispatch-shape policy, phase classification, and kernel choice.
+
+    ``enabled`` resolves ``EngineConfig.ragged_attention``: ``None`` means
+    auto — ON for paged caches on a real TPU backend (where the ragged
+    kernel replaces the gather copy), OFF elsewhere so CPU defaults keep
+    the legacy bucketed path (tests opt in explicitly; the plan's shaping
+    and co-scheduling are backend-agnostic and byte-exact either way).
+    """
+
+    def __init__(self, engine_cfg, cache_cfg, metrics=None, backend=None):
+        self.ecfg = engine_cfg
+        self.ccfg = cache_cfg
+        self.metrics = metrics
+        self.backend = backend or jax.default_backend()
+        self.buckets: Tuple[int, ...] = tuple(engine_cfg.prefill_buckets)
+        if engine_cfg.ragged_attention is not None:
+            self.enabled = bool(engine_cfg.ragged_attention)
+        else:
+            self.enabled = (
+                self.backend == "tpu" and cache_cfg.kind == "paged"
+            )
+        self.chunk_tokens = (
+            engine_cfg.prefill_chunk_tokens
+            if engine_cfg.prefill_chunk_tokens is not None
+            else self.buckets[-1]
+        )
+        if self.chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, got {self.chunk_tokens}"
+            )
+        self.share = float(engine_cfg.chunk_decode_share)
+        if not 0.0 <= self.share <= 1.0:
+            raise ValueError(
+                f"chunk_decode_share must be in [0, 1], got {self.share}"
+            )
+        self._credit = 0.0
+        self._shapes = set()
+
+    # ------------------------------------------------------------------
+    # Row classification / shape policy
+    # ------------------------------------------------------------------
+    def classify(self, new_tokens: int, total_prompt: int) -> str:
+        """Phase of a dispatch serving ``new_tokens`` query rows of a
+        ``total_prompt``-token prompt (1 query = decode)."""
+        if new_tokens <= 1 and total_prompt > 1:
+            return DECODE
+        if new_tokens < total_prompt:
+            return CHUNKED
+        return PREFILL
+
+    def bucket_for(self, n: int) -> int:
+        """LEGACY prompt bucket — still the admission-partition key in
+        ragged mode (see module docstring: partition == PRNG key order)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def prefill_stride(self, legacy_cap: int) -> int:
+        """Tokens consumed per chunk when a prompt walks in pieces. Capped
+        at the legacy chunk cap (sink caches bound it by the window) so the
+        default config's chunk boundaries — hence interior/final dispatch
+        sequence — match the legacy path exactly."""
+        if not self.enabled:
+            return legacy_cap
+        return min(self.chunk_tokens, legacy_cap)
+
+    def final_shape(self, rest: int, legacy_cap: int) -> int:
+        """Pad width for the final (sampled) chunk of a single-row prefill.
+        Ragged mode pads every final to the stride — ONE warm shape per row
+        count — instead of the rest's bucket."""
+        if not self.enabled:
+            return self.bucket_for(rest)
+        return self.prefill_stride(legacy_cap)
+
+    def group_shape(self, bucket: int, legacy_cap: int) -> int:
+        """Pad width for a batched admission group whose members share
+        ``bucket``. Ragged mode pads every group to the largest width so
+        all buckets share one executable per row count."""
+        if not self.enabled:
+            return bucket
+        return max(self.prefill_stride(legacy_cap), bucket)
+
+    def install_pads(self, batch: int, max_pages: int) -> Tuple[int, int]:
+        """Page-table install scatter pads (small burst, big burst) —
+        folded in from ``_flush_installs``/``_install_bucket`` so the warm
+        executable set for table writes is owned next to the dispatch
+        shapes it serves."""
+        big = 1
+        while big < max(batch, max_pages):
+            big *= 2
+        return (4, big)
+
+    # ------------------------------------------------------------------
+    # Kernel selection
+    # ------------------------------------------------------------------
+    def select(self) -> KernelSelection:
+        cc = self.ccfg
+        tpu = self.backend == "tpu"
+        # The ragged kernel is TPU-only in production: interpret mode is
+        # orders of magnitude slower than XLA on CPU, so off-TPU the plan
+        # keeps the gather path (ragged SHAPES still apply — parity is pad-
+        # width-invariant) and the kernel is exercised by ops-level tests.
+        use_ragged = self.enabled and tpu and cc.kind == "paged"
+        if self.ecfg.use_pallas_attention is not None:
+            use_pallas = self.ecfg.use_pallas_attention
+        else:
+            use_pallas = tpu and (
+                (cc.kind in ("dense", "sink") and cc.kv_quant == "int8")
+                or use_ragged
+            )
+        return KernelSelection(use_pallas=use_pallas, use_ragged=use_ragged)
+
+    # ------------------------------------------------------------------
+    # Chunk/decode co-scheduling
+    # ------------------------------------------------------------------
+    def co_schedule_ok(self, prompt_rest: int, temperature: float,
+                       legacy_cap: int) -> bool:
+        """Config-side eligibility for riding a prompt's prefill on the
+        decode cadence: ragged mode on, a non-zero tick share, a prompt
+        long enough to need chunking, and greedy decoding (a sampled
+        session must keep the legacy key-draw position — chunk ticks would
+        move its key relative to admission order)."""
+        return (
+            self.enabled
+            and self.share > 0.0
+            and temperature == 0.0
+            and prompt_rest > self.prefill_stride(legacy_cap)
+        )
+
+    def take_chunk_credit(self, decode_active: bool) -> bool:
+        """True when this tick may carry a chunk dispatch. With no decode
+        rows to protect the chunk streams at full speed; otherwise credits
+        accrue at ``chunk_decode_share`` per tick."""
+        if not decode_active:
+            return True
+        self._credit += self.share
+        if self._credit >= 1.0:
+            self._credit -= 1.0
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # Dispatch telemetry
+    # ------------------------------------------------------------------
+    def note_dispatch(self, kind: str, shape: Tuple[int, ...],
+                      valid_tokens: Optional[int] = None) -> None:
+        """Record one attention dispatch: first-seen (kind, shape) is one
+        fresh executable (``attn_recompiles``); prefill-family dispatches
+        under ragged mode count ``attn_ragged_dispatches`` and publish the
+        valid/padded occupancy gauge."""
+        key = (kind,) + tuple(int(x) for x in shape)
+        if key not in self._shapes:
+            self._shapes.add(key)
+            if self.metrics is not None:
+                self.metrics.counter("attn_recompiles")
+        if self.metrics is None:
+            return
+        if self.enabled and kind != DECODE:
+            self.metrics.counter("attn_ragged_dispatches")
+        if valid_tokens is not None:
+            padded = 1
+            for x in shape:
+                padded *= int(x)
+            if padded > 0:
+                self.metrics.gauge(
+                    "attn_grid_occupancy", valid_tokens / padded
+                )
+
+    def note_chunk_rows(self, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.counter("attn_chunked_rows", n)

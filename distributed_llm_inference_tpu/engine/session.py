@@ -13,7 +13,7 @@ import dataclasses
 import enum
 import itertools
 import time
-from typing import List, Optional
+from typing import Any, List, Optional
 
 from .sampling import SamplingOptions
 
@@ -74,6 +74,19 @@ class Session:
     # snapshot (engine.resume_session). Carried through checkpoints so a
     # twice-migrated session reports 2, not 1.
     resumes: int = 0
+    # Chunked-prefill co-scheduling state (engine/plan.py): while True the
+    # session occupies its slot but is NOT decode-eligible — the engine's
+    # chunk dispatcher walks the prompt ``plan.prefill_stride`` tokens per
+    # granted tick and flips this off when the final chunk samples the
+    # first token. ``chunk_off`` is the next unprefilled prompt offset;
+    # ``chunk_skip`` carries the admission-time prefix-cache skip;
+    # ``parked_key`` is the PRNG key drawn AT ADMISSION (the stream
+    # position the legacy synchronous prefill would have consumed) and
+    # spent by the final chunk's sample.
+    chunking: bool = False
+    chunk_off: int = 0
+    chunk_skip: int = 0
+    parked_key: Optional[Any] = None
     # Admission-ordering stamp from the gateway scheduler (sched/): a
     # sortable ``(lane_rank, virtual_finish, seq)`` tuple consumed by the
     # engine's admission-order hook. None = direct engine user, admitted

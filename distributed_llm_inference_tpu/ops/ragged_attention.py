@@ -1,0 +1,489 @@
+"""Pallas ragged mixed-phase paged attention (one grid for every phase).
+
+``paged_attention.py`` killed the decode-side gather; prefill and chunked
+prefill still route through ``cache/paged.py:update_and_gather`` — a full
+contiguous ``[B, max_len, Hkv, D]`` copy of every row's pages per layer —
+and through per-bucket padded dispatches (``engine/engine.py:_bucket_for``),
+whose one-executable-per-bucket tax BENCH_r05 measured at 23–28% of nominal
+prefill TFLOP/s and a 4258→479 tok/s decode collapse from 128 to 2k context.
+
+This kernel serves rows with PER-ROW true lengths in ONE grid call:
+
+* ``num_new[b]`` query tokens for row ``b`` start at absolute position
+  ``q_start[b]`` and attend causally over that row's first ``kv_lengths[b]``
+  pool slots. A full prefill row (``q_start == 0``), a chunked-prefill row
+  (``q_start > 0``, ``num_new == C``), and a decode row (``num_new == 1``)
+  are the SAME cell of the same grid — phase is data, not shape, so mixed
+  prefill/decode batches never recompile.
+* K/V stream IN PLACE from the page pool exactly as the decode kernel: the
+  grid walks ``(batch, q-block, page)`` with the page table scalar-prefetched,
+  and the index map clamps dead blocks — a page past the row's live span,
+  past the causal frontier of this q-block, or under a q-block past the
+  row's query count — to the null page 0, so short rows in a ragged batch
+  fetch one hot cached page instead of the table span.
+* The query tile ``[BQ, Hkv, G, D]`` rides the MXU as an ``Hkv``-batched
+  ``[BQ*G, D] x [D, PS]`` ``dot_general`` (prefill has real row counts; the
+  1-row VPU special case in ``_paged_kernel`` only pays off at ``BQ*G == 1``).
+
+Online-softmax state is VMEM scratch carried across the page axis (innermost,
+so one (row, q-block)'s sweep owns it), in the exact idiom of
+``paged_attention._paged_kernel``. Runs in interpret mode off-TPU so tier-1
+CPU tests exercise the same code path as the chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import _NEG_INF
+
+__all__ = [
+    "ragged_paged_attention",
+    "quantized_ragged_paged_attention",
+    "ragged_attention_reference",
+]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _ragged_kernel(
+    table_ref,   # SMEM [B, T] int32 (scalar prefetch)
+    len_ref,     # SMEM [B] int32: live kv per row (incl. this call's tokens)
+    qstart_ref,  # SMEM [B] int32: absolute position of the row's first query
+    nnew_ref,    # SMEM [B] int32: valid query rows in this call
+    q_ref,       # [1, BQ, Hkv, G, D]
+    k_ref,       # [1, Hkv, PS, D]
+    v_ref,       # [1, Hkv, PS, D]
+    out_ref,     # [1, BQ, Hkv, G, D]
+    acc_ref,     # VMEM [Hkv*BQ*G, D] f32
+    m_ref,       # VMEM [Hkv*BQ*G, 128] f32
+    l_ref,       # VMEM [Hkv*BQ*G, 128] f32
+    *,
+    scale: float,
+    page_size: int,
+    num_page_blocks: int,
+    block_q: int,
+    sliding_window: Optional[int],
+    hkv: int,
+    g: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[b]
+    rows = hkv * block_q * g
+
+    # Flat scratch row r covers (head = r // (BQ*G), query = (r % (BQ*G))
+    # // G); its query's position inside the dispatch and in the sequence:
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    q_rel = qi * block_q + (ridx % (block_q * g)) // g
+    q_pos = qstart_ref[b] + q_rel
+
+    # Per-(query, slot) mask: slot live, causal vs the query's absolute
+    # position, and the query itself valid (pad rows past num_new mask to
+    # all-dead → l == 0 → zeros at finalize).
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )
+    valid = (pos < kv_len) & (pos <= q_pos) & (q_rel < nnew_ref[b])
+    if sliding_window is not None:
+        valid &= pos > q_pos - sliding_window
+
+    # [BQ, Hkv, G, D] -> kv-head-major [Hkv, BQ*G, D] so QK^T/PV batch over
+    # kv heads with real MXU row counts.
+    q = jnp.transpose(q_ref[0], (1, 0, 2, 3)).reshape(hkv, block_q * g, -1)
+    k = k_ref[0]  # [Hkv, PS, D]
+    v = v_ref[0]
+
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).reshape(rows, page_size)
+    s = s * scale
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    pg = p.reshape(hkv, block_q * g, page_size).astype(v.dtype)
+    pv = jax.lax.dot_general(
+        pg, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] = acc_ref[:] * alpha + pv.reshape(rows, -1)
+
+    @pl.when(j == num_page_blocks - 1)
+    def _finalize():
+        # Fully-masked rows (pad queries, kv_len == 0) have l == 0 → zeros.
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out = out.reshape(hkv, block_q, g, -1)
+        out_ref[0] = jnp.transpose(out, (1, 0, 2, 3)).astype(out_ref.dtype)
+
+
+def _qragged_kernel(
+    table_ref,   # SMEM [B, T] int32
+    len_ref,     # SMEM [B] int32
+    qstart_ref,  # SMEM [B] int32
+    nnew_ref,    # SMEM [B] int32
+    q_ref,       # [1, BQ, Hkv, G, D]
+    k_ref,       # [1, Hkv, PS, D] int8
+    ks_ref,      # [1, Hkv, PS] f32
+    v_ref,       # [1, Hkv, PS, D] int8
+    vs_ref,      # [1, Hkv, PS] f32
+    out_ref,     # [1, BQ, Hkv, G, D]
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale: float,
+    page_size: int,
+    num_page_blocks: int,
+    block_q: int,
+    sliding_window: Optional[int],
+    hkv: int,
+    g: int,
+):
+    """int8 page variant of :func:`_ragged_kernel`: per-(slot, head) scales
+    apply to the SCORES/probs (``q·(k·s) = s·(q·k)``), so the int8 pages
+    stream through VMEM without a dequantized copy."""
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[b]
+    rows = hkv * block_q * g
+
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    q_rel = qi * block_q + (ridx % (block_q * g)) // g
+    q_pos = qstart_ref[b] + q_rel
+
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )
+    valid = (pos < kv_len) & (pos <= q_pos) & (q_rel < nnew_ref[b])
+    if sliding_window is not None:
+        valid &= pos > q_pos - sliding_window
+
+    q = jnp.transpose(q_ref[0], (1, 0, 2, 3)).reshape(hkv, block_q * g, -1)
+    k = k_ref[0]   # [Hkv, PS, D] int8
+    ks = ks_ref[0]  # [Hkv, PS] f32
+
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * ks[:, None, :]
+    s = s.reshape(rows, page_size) * scale
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    v = v_ref[0]    # [Hkv, PS, D] int8
+    vs = vs_ref[0]  # [Hkv, PS] f32
+    pw = p.reshape(hkv, block_q * g, page_size) * vs[:, None, :]
+    pv = jax.lax.dot_general(
+        pw, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] = acc_ref[:] * alpha + pv.reshape(rows, -1)
+
+    @pl.when(j == num_page_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-20)
+        out = out.reshape(hkv, block_q, g, -1)
+        out_ref[0] = jnp.transpose(out, (1, 0, 2, 3)).astype(out_ref.dtype)
+
+
+def _prep(q, page_size, block_q):
+    b, s, hq, d = q.shape
+    if block_q is None:
+        block_q = min(128, _next_pow2(s))
+    s_pad = -(-s // block_q) * block_q
+    return b, s, hq, d, block_q, s_pad
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    num_new: jnp.ndarray,
+    q_start: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_q: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Ragged mixed-phase attention straight over the page pool.
+
+    ``q``: ``[B, S, Hq, D]`` (already rotated; rows ragged — row ``b``'s
+    first ``num_new[b]`` tokens are real, the rest pad); ``k_pages`` /
+    ``v_pages``: ``[P, Hkv, page_size, D]`` one layer's pool, keys stored
+    rotated; ``page_table``: ``[B, T]`` int32 physical page ids (slot order
+    = position order, 0 = null page); ``kv_lengths``: ``[B]`` int32 live kv
+    per row INCLUDING this call's scattered tokens; ``num_new``: ``[B]``
+    int32 valid query count per row (1 = decode row, C = chunk row, full
+    prompt = prefill row — one grid serves all three); ``q_start``: ``[B]``
+    absolute position of each row's first query (defaults to
+    ``kv_lengths - num_new`` — queries are the newest tokens). Returns
+    ``[B, S, Hq, D]`` with pad query rows zeroed.
+    """
+    _, hkv, page_size, _ = k_pages.shape
+    b, s, hq, d, bq, s_pad = _prep(q, page_size, block_q)
+    t = page_table.shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if q_start is None:
+        q_start = kv_lengths - num_new
+
+    qr = q.reshape(b, s, hkv, g, d)
+    if s_pad != s:
+        qr = jnp.pad(qr, ((0, 0), (0, s_pad - s), (0, 0), (0, 0), (0, 0)))
+
+    def _page_index(bi, qi, ji, table, lens, qstart, nnew):
+        # Clamp dead blocks to the null page: past the row's live span, past
+        # this q-block's causal frontier, or under a q-block past the row's
+        # query count. The fetch still happens (BlockSpec semantics) but
+        # hits one hot page.
+        live = (
+            (ji * page_size < lens[bi])
+            & (qi * bq < nnew[bi])
+            & (ji * page_size <= qstart[bi] + qi * bq + bq - 1)
+        )
+        return (jnp.where(live, table[bi, ji], 0), 0, 0, 0)
+
+    def _q_index(bi, qi, ji, table, lens, qstart, nnew):
+        return (bi, qi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, s_pad // bq, t),
+        in_specs=[
+            pl.BlockSpec((1, bq, hkv, g, d), _q_index),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+        ],
+        out_specs=pl.BlockSpec((1, bq, hkv, g, d), _q_index),
+        scratch_shapes=[
+            pltpu.VMEM((hkv * bq * g, d), jnp.float32),
+            pltpu.VMEM((hkv * bq * g, 128), jnp.float32),
+            pltpu.VMEM((hkv * bq * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel,
+        scale=scale,
+        page_size=page_size,
+        num_page_blocks=t,
+        block_q=bq,
+        sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, s_pad, hkv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lengths.astype(jnp.int32),
+      q_start.astype(jnp.int32), num_new.astype(jnp.int32),
+      qr, k_pages, v_pages)
+    return out[:, :s].reshape(b, s, hq, d)
+
+
+def quantized_ragged_paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    ks_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    vs_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    num_new: jnp.ndarray,
+    q_start: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_q: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """As :func:`ragged_paged_attention` over int8 pages with per-(slot,
+    head) scale planes (``ks_pages``/``vs_pages``: ``[P, Hkv, page_size]``
+    f32)."""
+    _, hkv, page_size, _ = k_pages.shape
+    b, s, hq, d, bq, s_pad = _prep(q, page_size, block_q)
+    t = page_table.shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if q_start is None:
+        q_start = kv_lengths - num_new
+
+    qr = q.reshape(b, s, hkv, g, d)
+    if s_pad != s:
+        qr = jnp.pad(qr, ((0, 0), (0, s_pad - s), (0, 0), (0, 0), (0, 0)))
+
+    def _page_index(bi, qi, ji, table, lens, qstart, nnew):
+        live = (
+            (ji * page_size < lens[bi])
+            & (qi * bq < nnew[bi])
+            & (ji * page_size <= qstart[bi] + qi * bq + bq - 1)
+        )
+        return (jnp.where(live, table[bi, ji], 0), 0, 0, 0)
+
+    def _page_index3(bi, qi, ji, table, lens, qstart, nnew):
+        live = (
+            (ji * page_size < lens[bi])
+            & (qi * bq < nnew[bi])
+            & (ji * page_size <= qstart[bi] + qi * bq + bq - 1)
+        )
+        return (jnp.where(live, table[bi, ji], 0), 0, 0)
+
+    def _q_index(bi, qi, ji, table, lens, qstart, nnew):
+        return (bi, qi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, s_pad // bq, t),
+        in_specs=[
+            pl.BlockSpec((1, bq, hkv, g, d), _q_index),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, page_size), _page_index3),
+            pl.BlockSpec((1, hkv, page_size, d), _page_index),
+            pl.BlockSpec((1, hkv, page_size), _page_index3),
+        ],
+        out_specs=pl.BlockSpec((1, bq, hkv, g, d), _q_index),
+        scratch_shapes=[
+            pltpu.VMEM((hkv * bq * g, d), jnp.float32),
+            pltpu.VMEM((hkv * bq * g, 128), jnp.float32),
+            pltpu.VMEM((hkv * bq * g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _qragged_kernel,
+        scale=scale,
+        page_size=page_size,
+        num_page_blocks=t,
+        block_q=bq,
+        sliding_window=sliding_window,
+        hkv=hkv,
+        g=g,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, s_pad, hkv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lengths.astype(jnp.int32),
+      q_start.astype(jnp.int32), num_new.astype(jnp.int32),
+      qr, k_pages, ks_pages, v_pages, vs_pages)
+    return out[:, :s].reshape(b, s, hq, d)
+
+
+def ragged_attention_reference(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    num_new: jnp.ndarray,
+    ks_pages: Optional[jnp.ndarray] = None,
+    vs_pages: Optional[jnp.ndarray] = None,
+    q_start: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+):
+    """XLA oracle for the ragged kernels: gathers the table span into a
+    contiguous view (the exact copy the kernel exists to avoid) and runs a
+    masked f32 softmax. Tests compare against this; dequantizes int8 pools
+    when scale planes are given."""
+    b, s, hq, d = q.shape
+    _, hkv, page_size, _ = k_pages.shape
+    t = page_table.shape[1]
+    g = hq // hkv
+    if scale is None:
+        scale = d**-0.5
+    if q_start is None:
+        q_start = kv_lengths - num_new
+
+    k = jnp.take(k_pages, page_table, axis=0)  # [B, T, Hkv, PS, D]
+    v = jnp.take(v_pages, page_table, axis=0)
+    k = jnp.moveaxis(k, 2, 3).reshape(b, t * page_size, hkv, d)
+    v = jnp.moveaxis(v, 2, 3).reshape(b, t * page_size, hkv, d)
+    if ks_pages is not None:
+        ks = jnp.take(ks_pages, page_table, axis=0)  # [B, T, Hkv, PS]
+        vs = jnp.take(vs_pages, page_table, axis=0)
+        ks = jnp.moveaxis(ks, 2, 3).reshape(b, t * page_size, hkv)
+        vs = jnp.moveaxis(vs, 2, 3).reshape(b, t * page_size, hkv)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
+
+    qr = q.reshape(b, s, hkv, g, d)
+    scores = jnp.einsum(
+        "bshgd,bthd->bhgst", qr.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+
+    q_pos = q_start[:, None] + jnp.arange(s)[None, :]          # [B, S]
+    kv_pos = jnp.arange(t * page_size)[None, :]                # [1, KV]
+    valid = (
+        (kv_pos[:, None, :] <= q_pos[:, :, None])
+        & (kv_pos[:, None, :] < kv_lengths[:, None, None])
+    )                                                          # [B, S, KV]
+    if sliding_window is not None:
+        valid &= kv_pos[:, None, :] > q_pos[:, :, None] - sliding_window
+    scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v.astype(jnp.float32))
+    q_valid = jnp.arange(s)[None, :] < num_new[:, None]        # [B, S]
+    out = jnp.where(q_valid[..., None, None, None], out, 0.0)
+    return out.reshape(b, s, hq, d).astype(q.dtype)

@@ -59,6 +59,11 @@ METRICS = {
     "prefix_reload_ms": ("summary", "Host->device prefix page reload time"),
     "prefix_reload_errors": ("counter", "Arena entries rejected at reload"),
     "routed_by_prefix": ("counter", "Requests routed to a prefix-holding node"),
+    # engine: attention plan (ragged mixed-phase dispatch — engine/plan.py)
+    "attn_recompiles": ("counter", "First-seen attention dispatch shapes"),
+    "attn_ragged_dispatches": ("counter", "Prefill-family ragged dispatches"),
+    "attn_chunked_rows": ("counter", "Chunk rows co-scheduled with decode"),
+    "attn_grid_occupancy": ("gauge", "Valid/padded tokens, last dispatch"),
     "decode_step": ("summary", "One decode tick (dispatch+resolve)"),
     "decode_resolve": ("summary", "Deferred decode fetch latency"),
     "decode_tokens": ("counter", "Tokens emitted by decode"),

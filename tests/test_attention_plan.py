@@ -1,0 +1,372 @@
+"""AttentionPlan + ragged mixed-phase attention contract tests.
+
+The licence for turning ``ragged_attention`` on at all is byte-exact
+parity with the legacy bucketed dispatch across the serving matrix —
+greedy AND sampled (the plan keeps the legacy admission partition and
+PRNG key order; only padded dispatch widths change, which sampling is
+invariant to). The ops-level cases pin the ragged kernel itself against
+its XLA reference oracle in interpret mode; the engine cases pin the
+plan's dispatch-shape policy, chunk/decode co-scheduling, and the
+single-widen admission-burst rule (one cache growth per tick, not one
+per ladder rung).
+
+Deliberately NOT marked 'slow': these are the correctness gate for the
+plan-owned dispatch path and must run in every tier-1 pass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.plan import (
+    CHUNKED,
+    DECODE,
+    PREFILL,
+    AttentionPlan,
+)
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.ops.ragged_attention import (
+    quantized_ragged_paged_attention,
+    ragged_attention_reference,
+    ragged_paged_attention,
+)
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_engine(ragged=None, kind="paged", batch=4, chunk=None, share=0.5,
+                kv_quant=None, **ekw):
+    return InferenceEngine(
+        CFG, PARAMS,
+        EngineConfig(
+            max_batch_size=batch, prefill_buckets=(8, 16, 32), max_seq_len=64,
+            dtype="float32", ragged_attention=ragged,
+            prefill_chunk_tokens=chunk, chunk_decode_share=share, **ekw,
+        ),
+        CacheConfig(
+            kind=kind, page_size=8, num_pages=64, max_pages_per_session=8,
+            window_length=32, num_sink_tokens=2, kv_quant=kv_quant,
+        ),
+    )
+
+
+def prompts(n, lo=3, hi=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, CFG.vocab_size, size=rng.integers(lo, hi)).tolist()
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Ops level: ragged kernel vs XLA reference oracle (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+def _mixed_phase_inputs(seed=0, dtype=jnp.float32):
+    """One grid call serving a decode row, a chunked row, a full prefill,
+    and a short prefill — the kernel's whole reason to exist."""
+    rng = np.random.default_rng(seed)
+    B, S, Hq, Hkv, D, PS, P, T = 4, 16, 4, 2, 16, 8, 32, 6
+    q = jnp.asarray(rng.standard_normal((B, S, Hq, D)), dtype)
+    k_pages = jnp.asarray(rng.standard_normal((P, Hkv, PS, D)), dtype)
+    v_pages = jnp.asarray(rng.standard_normal((P, Hkv, PS, D)), dtype)
+    table = jnp.asarray(
+        rng.permutation(P - 1)[: B * T].reshape(B, T) + 1, jnp.int32
+    )
+    kv_len = jnp.asarray([40, 33, 16, 5], jnp.int32)  # post-write lengths
+    num_new = jnp.asarray([1, 16, 16, 5], jnp.int32)
+    kv_len = jnp.minimum(kv_len, T * PS)
+    return q, k_pages, v_pages, table, kv_len, num_new
+
+
+@pytest.mark.parametrize("sliding_window", [None, 12])
+def test_ragged_kernel_matches_reference(sliding_window):
+    q, kp, vp, table, kv_len, num_new = _mixed_phase_inputs()
+    out = ragged_paged_attention(
+        q, kp, vp, table, kv_len, num_new,
+        sliding_window=sliding_window, interpret=True,
+    )
+    ref = ragged_attention_reference(
+        q, kp, vp, table, kv_len, num_new, sliding_window=sliding_window
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("sliding_window", [None, 12])
+def test_quantized_ragged_kernel_matches_reference(sliding_window):
+    rng = np.random.default_rng(3)
+    q, kp, vp, table, kv_len, num_new = _mixed_phase_inputs(seed=3)
+    ks = jnp.asarray(
+        0.5 + rng.random(kp.shape[:3]).astype(np.float32)
+    )
+    vs = jnp.asarray(0.5 + rng.random(vp.shape[:3]).astype(np.float32))
+    kq = jnp.asarray(
+        np.clip(np.round(np.asarray(kp) / np.asarray(ks)[..., None]),
+                -127, 127), jnp.int8,
+    )
+    vq = jnp.asarray(
+        np.clip(np.round(np.asarray(vp) / np.asarray(vs)[..., None]),
+                -127, 127), jnp.int8,
+    )
+    out = quantized_ragged_paged_attention(
+        q, kq, ks, vq, vs, table, kv_len, num_new,
+        sliding_window=sliding_window, interpret=True,
+    )
+    ref = ragged_attention_reference(
+        q, kq, vq, table, kv_len, num_new, ks_pages=ks, vs_pages=vs,
+        sliding_window=sliding_window,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_kernel_multi_query_block():
+    """An odd length that spans several q blocks (block_q < S)."""
+    rng = np.random.default_rng(9)
+    B, S, Hq, Hkv, D, PS, T = 2, 13, 4, 2, 16, 8, 4
+    P = 16
+    q = jnp.asarray(rng.standard_normal((B, S, Hq, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((P, Hkv, PS, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, Hkv, PS, D)), jnp.float32)
+    table = jnp.asarray(
+        rng.permutation(P - 1)[: B * T].reshape(B, T) + 1, jnp.int32
+    )
+    kv_len = jnp.asarray([25, 13], jnp.int32)
+    num_new = jnp.asarray([13, 13], jnp.int32)
+    out = ragged_paged_attention(
+        q, kp, vp, table, kv_len, num_new, block_q=4, interpret=True
+    )
+    ref = ragged_attention_reference(q, kp, vp, table, kv_len, num_new)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Plan unit contracts
+# ---------------------------------------------------------------------------
+
+def _plans(ragged):
+    e = EngineConfig(
+        prefill_buckets=(8, 16, 32), ragged_attention=ragged,
+        max_batch_size=4,
+    )
+    return AttentionPlan(e, CacheConfig(kind="paged"))
+
+
+def test_plan_classify_and_shapes():
+    p = _plans(True)
+    assert p.classify(1, 40) == DECODE
+    assert p.classify(8, 40) == CHUNKED
+    assert p.classify(12, 12) == PREFILL
+    # Legacy partition key is unchanged by ragged mode...
+    assert p.bucket_for(5) == 8 and p.bucket_for(17) == 32
+    assert p.bucket_for(99) == 32
+    # ...but every prefill-family pad width collapses to one stride.
+    assert p.prefill_stride(32) == 32
+    assert p.final_shape(5, 32) == 32
+    assert p.group_shape(8, 32) == 32
+    legacy = _plans(False)
+    assert legacy.final_shape(5, 32) == 8  # the old per-bucket pad
+    assert legacy.group_shape(8, 32) == 8
+    small, big = p.install_pads(4, 8)
+    assert small == 4 and big == 8 and (big & (big - 1)) == 0
+
+
+def test_plan_credit_accumulator():
+    p = _plans(True)
+    p.share = 0.5
+    grants = [p.take_chunk_credit(True) for _ in range(8)]
+    assert sum(grants) == 4  # every other decode tick carries a chunk
+    assert p.take_chunk_credit(False)  # no decode => full speed, no credit
+
+
+def test_plan_recompile_counter_first_seen_only():
+    from distributed_llm_inference_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    e = EngineConfig(prefill_buckets=(8,), ragged_attention=True)
+    p = AttentionPlan(e, CacheConfig(kind="paged"), metrics=m)
+    p.note_dispatch("prefill", (1, 8), 5)
+    p.note_dispatch("prefill", (1, 8), 3)
+    p.note_dispatch("decode", (4, 16, 64))
+    assert m.get_counter("attn_recompiles") == 2.0
+    assert m.get_counter("attn_ragged_dispatches") == 2.0
+    assert m.get_gauge("attn_grid_occupancy") == pytest.approx(3 / 8)
+
+
+# ---------------------------------------------------------------------------
+# Engine parity: ragged on/off must be byte-exact across the matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["paged", "dense"])
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+@pytest.mark.parametrize("sampled", [False, True])
+def test_ragged_parity_matrix(kind, kv_quant, sampled):
+    ps = prompts(6)
+    opts = (
+        SamplingOptions(max_new_tokens=5, temperature=0.9, top_k=40)
+        if sampled else SamplingOptions(max_new_tokens=5)
+    )
+    base = make_engine(ragged=False, kind=kind, kv_quant=kv_quant).generate(
+        ps, opts
+    )
+    rag = make_engine(ragged=True, kind=kind, kv_quant=kv_quant).generate(
+        ps, opts
+    )
+    assert base == rag
+
+
+def test_chunked_admission_mid_decode_parity():
+    """A long greedy prompt landing beside live decode rows chunk-admits
+    (attn_chunked_rows > 0) and still produces the legacy tokens."""
+    rng = np.random.default_rng(7)
+    mix = [prompts(2)[0], rng.integers(0, 128, size=30).tolist(),
+           prompts(2)[1]]
+    opts = SamplingOptions(max_new_tokens=6)
+    base = make_engine(ragged=False).generate(mix, opts)
+    eng = make_engine(ragged=True, chunk=8, share=0.5)
+    assert eng.generate(mix, opts) == base
+    assert eng.metrics.get_counter("attn_chunked_rows") > 0
+
+
+def test_chunked_admission_sampled_rider_parity():
+    """Sampled SHORT sessions ride beside a chunking greedy prompt: their
+    key-draw positions must be untouched by the parked admission."""
+    rng = np.random.default_rng(11)
+    mix = [prompts(2, seed=5)[0], rng.integers(0, 128, size=28).tolist()]
+    opts = SamplingOptions(max_new_tokens=6, temperature=0.8, top_k=30)
+    base = make_engine(ragged=False).generate(mix, opts)
+    eng = make_engine(ragged=True, chunk=8, share=0.5)
+    assert eng.generate(mix, opts) == base
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+def test_chunked_admission_pipelined_parity(overlap):
+    rng = np.random.default_rng(13)
+    mix = [prompts(3, seed=2)[0], rng.integers(0, 128, size=30).tolist(),
+           prompts(3, seed=2)[2]]
+    opts = SamplingOptions(max_new_tokens=6)
+    kw = dict(pipelined_ticks=True, overlap_admission=overlap)
+    base = make_engine(ragged=False, **kw).generate(mix, opts)
+    eng = make_engine(ragged=True, chunk=8, **kw)
+    assert eng.generate(mix, opts) == base
+    assert eng.metrics.get_counter("attn_chunked_rows") > 0
+
+
+def test_cancel_mid_chunk_releases_row():
+    """Cancel landing while a session is parked mid chunked-prefill emits
+    the terminal event and frees its pages; its partially-written pages
+    must NOT be registered as shareable prefix content."""
+    eng = make_engine(ragged=True, chunk=8, share=0.25, batch=2)
+    short = prompts(1, seed=3)[0]
+    longp = np.random.default_rng(5).integers(0, 128, size=30).tolist()
+    opts = SamplingOptions(max_new_tokens=32)
+    eng.submit(short, opts)
+    gid = eng.submit(longp, opts)
+    eng.step()  # admits both; long prompt parks for chunking
+    s = eng.sessions[gid]
+    assert s.chunking and s.slot is not None
+    eng.cancel(gid)
+    evs = eng.step()
+    assert (gid, -1, True) in evs
+    assert eng.sessions[gid].pages == []
+    assert not eng.sessions[gid].chunking
+    assert gid not in eng.slots
+    # Drain the survivor; the engine must stay healthy.
+    while eng.has_work():
+        eng.step()
+
+
+def test_deadline_mid_chunk_reaps():
+    eng = make_engine(ragged=True, chunk=8, share=0.25, batch=2)
+    short = prompts(1, seed=4)[0]
+    longp = np.random.default_rng(6).integers(0, 128, size=30).tolist()
+    import time as _time
+
+    eng.submit(short, SamplingOptions(max_new_tokens=16))
+    gid = eng.submit(longp, SamplingOptions(max_new_tokens=16),
+                     deadline=_time.monotonic() + 0.2)
+    eng.step()
+    assert eng.sessions[gid].chunking
+    _time.sleep(0.25)
+    evs = eng.step()
+    assert (gid, -1, True) in evs
+    assert eng.sessions[gid].finish_reason == "deadline"
+    while eng.has_work():
+        eng.step()
+
+
+def test_admit_prefilled_onto_ragged_engine():
+    """Disaggregated admission lands on a plan-managed engine unchanged:
+    export KV from one ragged engine, import into another, tokens match a
+    straight local run."""
+    opts = SamplingOptions(max_new_tokens=6)
+    p = prompts(1, seed=8)[0]
+    local = make_engine(ragged=True).generate([p], opts)[0]
+    src = make_engine(ragged=True)
+    planes, first, _chain = src.prefill_export(p)
+    dst = make_engine(ragged=True)
+    gid = dst.admit_prefilled(p, planes, first, options=opts)
+    toks = []
+    while dst.has_work():
+        for g, tok, fin in dst.step():
+            if g == gid and tok != -1:
+                toks.append(tok)
+    assert toks == local
+
+
+def test_admission_burst_single_growth():
+    """Satellite regression: an admission burst spanning ladder rungs in
+    ONE tick widens the table ONCE (max of the burst), not once per rung
+    — the one-shape-per-bucket growth recompile when an oversized backlog
+    and a growth tick land together."""
+    # A 4-rung ladder (slots 2/4/6/8) so the burst spans several rungs.
+    eng = make_engine(ragged=True, decode_windows=(16, 32, 48, 64))
+    base = int(eng.metrics.get_counter("cache_growths"))
+    rng = np.random.default_rng(17)
+    for n in (10, 25, 40, 56):
+        eng.submit(rng.integers(0, 128, size=n).tolist(),
+                   SamplingOptions(max_new_tokens=2))
+    eng.step()  # one tick admits all four (lengths 10→56: rungs 2,4,6,8)
+    grown = int(eng.metrics.get_counter("cache_growths")) - base
+    assert grown == 1, f"burst admission grew the cache {grown}x in one tick"
+    while eng.has_work():
+        eng.step()
+
+
+def test_zero_recompiles_after_warmup():
+    """Steady-state mixed-length traffic must add NO first-seen dispatch
+    shapes once the warm set exists (the plan's single-shape contract)."""
+    eng = make_engine(ragged=True)
+    opts = SamplingOptions(max_new_tokens=4)
+    # Warm the finite shape set explicitly: a 4-row group, a 2-row group,
+    # and a single (group pads are width-invariant under ragged mode, so
+    # only the ROW-COUNT pow2s and the one single/final width exist).
+    eng.generate([[1] * 6] * 4, opts)
+    eng.generate([[2] * 6] * 2, opts)
+    eng.generate([[3] * 20], opts)
+    warm = eng.metrics.get_counter("attn_recompiles")
+    assert warm > 0
+    # Steady state: mixed-length traffic over warm executables.
+    eng.generate(prompts(6, seed=22), opts)
+    eng.generate(prompts(6, lo=3, hi=12, seed=23), opts)
+    assert eng.metrics.get_counter("attn_recompiles") == warm
+
+
+def test_legacy_mode_shapes_unchanged():
+    """ragged_attention=False must reproduce the legacy per-bucket pads
+    (the plan is a refactor, not a behavior change, when disabled)."""
+    eng = make_engine(ragged=False)
+    eng.generate(prompts(4, seed=30), SamplingOptions(max_new_tokens=2))
+    assert eng.metrics.get_counter("attn_chunked_rows") == 0
+    assert eng.metrics.get_counter("attn_ragged_dispatches") == 0

@@ -61,6 +61,15 @@
 # correctness gate for zero-loss pool reshapes — the drain e2e combos
 # are the licence for fencing a live node at all. They ride the disagg
 # block at the end of the schedule (~90 s of the budget on CPU).
+# The attention-plan contract tests (tests/test_attention_plan.py:
+# ragged kernel vs reference oracle under interpret mode, AttentionPlan
+# shape/classify/credit unit contracts, byte-exact ragged-vs-bucketed
+# engine parity incl. chunked co-scheduling across plain/pipelined/
+# overlap ticks, cancel/deadline mid-chunk, and the single-growth
+# admission-burst + zero-steady-recompiles regressions) are deliberately
+# NOT marked 'slow': they are the correctness gate for the one-kernel
+# mixed-phase dispatch path — the parity matrix is what licenses
+# `ragged_attention` defaulting ON for paged TPU engines (~90 s on CPU).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
