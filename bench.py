@@ -1858,6 +1858,109 @@ def _recovery_phase() -> dict:
     return out
 
 
+def _kvbytes_phase() -> dict:
+    """Latent (MLA) KV compression accounting (`--phase kvbytes`, opt-in):
+    stored KV bytes per token, the max resident batch a fixed pool byte
+    budget holds at 2k context, the disagg prefill wire bytes, and the
+    migration checkpoint bytes — latent (f32 and int8 stored forms) vs
+    the conventional per-head paged baselines at proportional geometry
+    (Hkv=8 x D=32 per-head K/V vs one rank-64 + 16-dim rope latent; the
+    ratios, not the absolute tiny-model numbers, are the measurement).
+    CPU-scope: every number is a byte count, not a kernel time."""
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu":
+        return {"error": "backend already initialized non-cpu; run this "
+                         "phase in its own process",
+                "scope": "cpu-localhost"}
+    import dataclasses as _dc
+
+    from distributed_llm_inference_tpu.config import (
+        CacheConfig, EngineConfig, LatentConfig, ModelConfig,
+    )
+    from distributed_llm_inference_tpu.disagg.kv_codec import (
+        encode_kv, encode_session,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+    from distributed_llm_inference_tpu.models import llama as llama_mod
+
+    base_cfg = ModelConfig(
+        vocab_size=128, hidden_size=128, intermediate_size=256, num_layers=2,
+        num_heads=8, num_kv_heads=8, head_dim=32,
+    )
+    lat_cfg = _dc.replace(
+        base_cfg, family="mla", num_kv_heads=1,
+        latent=LatentConfig(rank=64, rope_head_dim=16),
+    )
+    ecfg = EngineConfig(max_batch_size=2, prefill_buckets=(16, 64),
+                        max_seq_len=128, dtype="float32")
+    ccfg = CacheConfig(kind="paged", page_size=16, num_pages=32,
+                       max_pages_per_session=8)
+    prompt = list(range(3, 51))  # 48 tokens
+    # Headroom over the export point: export_session only snapshots LIVE
+    # sessions, and pipelined ticks can drain several tokens per step().
+    opts = SamplingOptions(max_new_tokens=16)
+    pool_budget = 256 << 20  # fixed HBM budget the resident-batch count fills
+    ctx = 2048
+
+    def measure(cfg, kv_quant):
+        params = llama_mod.init_params(cfg, jax.random.PRNGKey(0),
+                                       jnp.float32)
+        cc = _dc.replace(ccfg, kv_quant=kv_quant)
+        eng = InferenceEngine(cfg, params, ecfg, cc,
+                              rng=jax.random.PRNGKey(1))
+        bpt = eng.metrics.get_gauge("kv_bytes_per_token")
+        planes, first, chain = eng.prefill_export(list(prompt), opts)
+        quant = "ks" in planes or "cs" in planes
+        wire = sum(len(f) for f in encode_kv(
+            "g", planes, len(prompt), first, chain,
+            page_size=cc.page_size, quant=quant,
+        ))
+        gid = eng.submit(list(prompt), opts)
+        emitted = 0
+        # Checkpoint right after the first token: tail-capable caches drain
+        # the WHOLE decode budget in one step(), so any later export point
+        # finds the session finished; first-token exports also put every
+        # variant's n_valid at len(prompt), keeping ckpt bytes comparable.
+        for _ in range(10):
+            emitted += sum(1 for _, tok, _ in eng.step() if tok >= 0)
+            if emitted >= 1:
+                break
+        snap = eng.export_session(gid)
+        ckpt = (sum(len(f) for f in encode_session(
+                    gid, snap, page_size=cc.page_size))
+                if snap is not None else None)
+        return {
+            "kv_bytes_per_token": bpt,
+            "batch_at_2k_ctx_256mb": int(pool_budget // (bpt * ctx)),
+            "kv_transfer_bytes": wire,
+            "migrate_ckpt_bytes": ckpt,
+            "latent_decompress_dispatches": int(eng.metrics.get_counter(
+                "latent_decompress_dispatches")),
+        }
+
+    out = {
+        "scope": "cpu-localhost",
+        "geometry": "L2 Hq8 Hkv8 D32 vs latent rank64+rope16",
+        "prompt_tokens": len(prompt),
+        "baseline_f32": measure(base_cfg, None),
+        "baseline_int8": measure(base_cfg, "int8"),
+        "latent_f32": measure(lat_cfg, None),
+        "latent_int8": measure(lat_cfg, "int8"),
+    }
+    for name in ("latent_f32", "latent_int8"):
+        b, l = out["baseline_f32"], out[name]
+        out[f"{name}_vs_baseline_f32"] = {
+            k: round(b[k] / l[k], 2)
+            for k in ("kv_bytes_per_token", "kv_transfer_bytes",
+                      "migrate_ckpt_bytes")
+            if b.get(k) and l.get(k)
+        }
+    out["targets"] = {"latent_f32_kv_bytes_per_token": ">=4x baseline_f32",
+                      "wire_and_ckpt": "drop proportionally"}
+    return out
+
+
 def _prefix_phase() -> dict:
     """Prefix/KV reuse (prefixstore/): a multi-turn workload where every
     request repeats a long shared system prompt. Cold requests (unique
@@ -2518,6 +2621,8 @@ def run_phase(name: str) -> dict:
         return _recovery_phase()
     if name == "prefix":
         return _prefix_phase()
+    if name == "kvbytes":
+        return _kvbytes_phase()
     if name == "traffic":
         return _traffic_phase(_ARRIVAL)
     if name == "elastic":

@@ -8,13 +8,21 @@ for tensor/pipeline/data/sequence parallelism, Pallas kernels for the attention
 hot paths, and a native relay for the cross-host (DCN) hop.
 """
 
-from .config import CacheConfig, EngineConfig, MeshConfig, ModelConfig, RopeScaling
+from .config import (
+    CacheConfig,
+    EngineConfig,
+    LatentConfig,
+    MeshConfig,
+    ModelConfig,
+    RopeScaling,
+)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "CacheConfig",
     "EngineConfig",
+    "LatentConfig",
     "MeshConfig",
     "ModelConfig",
     "RopeScaling",

@@ -39,6 +39,39 @@ class RopeScaling:
 
 
 @dataclasses.dataclass(frozen=True)
+class LatentConfig:
+    """Latent (low-rank) KV attention — MLA-style compression.
+
+    Per-head K/V is replaced by ONE shared ``rank``-dim latent per token
+    plus a ``rope_head_dim``-dim decoupled rotary key shared across heads.
+    The cache stores ``[latent ; rope_key]`` (``lat_dim`` floats/token) and
+    attention runs directly over it in the absorbed formulation: queries are
+    up-projected into latent space (``w_uk`` folded into the query) and the
+    attention output's latent slice is up-projected to per-head values
+    (``w_uv``), so no per-token K/V decompression ever materializes — the
+    kernels read the stored latents in place. This is a different MODEL
+    (its own weights, gated via the ``mla`` registry family), not a lossy
+    re-encoding of an existing one: quality parity is a training-time
+    property; byte-exactness with the non-latent path is not expected.
+    """
+
+    enabled: bool = True
+    # Shared KV latent rank (DeepSeek-V2 ``kv_lora_rank``).
+    rank: int = 64
+    # Decoupled rotary key/query head dim (``qk_rope_head_dim``); rope is
+    # applied ONLY to this slice — the latent itself is position-free,
+    # which is what makes one stored latent serve every head.
+    rope_head_dim: int = 16
+    # No-rope query/key head dim (``qk_nope_head_dim``); None = head_dim.
+    nope_head_dim: Optional[int] = None
+
+    @property
+    def lat_dim(self) -> int:
+        """Stored per-token width: latent rank + decoupled rope key."""
+        return self.rank + self.rope_head_dim
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Architecture hyperparameters for a decoder-only transformer.
 
@@ -73,12 +106,22 @@ class ModelConfig:
     # keeps the exact dense-combine path everywhere — drops would also make
     # chunked prefill depend on chunk boundaries.
     moe_capacity_factor: Optional[float] = None
-    # Model family tag ("llama", "mistral", "qwen2", "mixtral").
+    # Latent (MLA-style) KV compression; requires the "mla" family and the
+    # paged cache kind. None = conventional per-head K/V.
+    latent: Optional[LatentConfig] = None
+    # Model family tag ("llama", "mistral", "qwen2", "mixtral", "mla").
     family: str = "llama"
 
     @property
     def q_per_kv(self) -> int:
         return self.num_heads // self.num_kv_heads
+
+    @property
+    def use_latent(self) -> bool:
+        """THE latent predicate — every consumer (model, engine, bench)
+        branches on this, so a present-but-disabled ``LatentConfig`` is
+        uniformly the baseline per-head path, never a half-latent mix."""
+        return self.latent is not None and self.latent.enabled
 
     @staticmethod
     def from_hf_config(hf: Any) -> "ModelConfig":
@@ -89,6 +132,16 @@ class ModelConfig:
         model_type = get("model_type", "llama")
         num_heads = get("num_attention_heads", 32)
         hidden = get("hidden_size", 4096)
+        latent = None
+        if get("kv_lora_rank", None):
+            # DeepSeek-V2/V3-style MLA checkpoint: map the latent dims and
+            # normalize the family tag to the registry's "mla".
+            latent = LatentConfig(
+                rank=int(get("kv_lora_rank")),
+                rope_head_dim=int(get("qk_rope_head_dim", 64)),
+                nope_head_dim=get("qk_nope_head_dim", None),
+            )
+            model_type = "mla"
         return ModelConfig(
             vocab_size=get("vocab_size", 32000),
             hidden_size=hidden,
@@ -106,6 +159,7 @@ class ModelConfig:
             qkv_bias=bool(get("attention_bias", False)) or model_type in ("qwen2",),
             num_experts=get("num_local_experts", 0) or 0,
             num_experts_per_tok=get("num_experts_per_tok", 2) or 2,
+            latent=latent,
             family=model_type,
         )
 

@@ -22,8 +22,8 @@ shipping) and ``serving.backends.FleetBackend`` (crash recovery).
 
 from .decode_node import DecodeNode
 from .kv_codec import (
-    decode_kv, decode_pages, decode_session, encode_error, encode_kv,
-    encode_pages, encode_session,
+    SchemaError, decode_kv, decode_pages, decode_session, encode_error,
+    encode_kv, encode_pages, encode_session,
 )
 from .prefill_worker import PrefillWorker
 
@@ -31,5 +31,6 @@ __all__ = [
     "encode_kv", "decode_kv", "encode_error",
     "encode_session", "decode_session",
     "encode_pages", "decode_pages",
+    "SchemaError",
     "PrefillWorker", "DecodeNode",
 ]

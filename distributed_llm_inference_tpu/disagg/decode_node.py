@@ -53,8 +53,16 @@ from ..distributed.messages import pack_frame, unpack_frame
 from ..distributed.relay import RelayClient
 from ..engine.sampling import SamplingOptions
 from .kv_codec import (
-    decode_pages, decode_session, encode_error, encode_pages, encode_session,
+    SchemaError, decode_pages, decode_session, encode_error, encode_pages,
+    encode_session,
 )
+
+
+def _err_code(e: Exception) -> str:
+    """Wire error code for a failed transfer: schema violations (codec
+    version/layout skew — peer needs an upgrade, not a retry) answer with
+    the typed ``schema`` code; everything else ships its repr."""
+    return "schema" if isinstance(e, SchemaError) else repr(e)
 
 __all__ = ["DecodeNode"]
 
@@ -252,7 +260,7 @@ class DecodeNode:
                 raise RuntimeError("no decode slot free (pool pressure)")
         except Exception as e:
             logger.warning("resume %s failed on %s: %r", gen, self.node_id, e)
-            self._send_err(reply, gen, att, repr(e))
+            self._send_err(reply, gen, att, _err_code(e))
             return  # distcheck: reply-ok(migrate.err reply sent via _send_err)
         g0 = len(tail)
         replay = [(i, tail[i]) for i in range(max(0, min(frm, g0)), g0)]
@@ -356,7 +364,7 @@ class DecodeNode:
             if reply:
                 self._send([(reply, pack_frame({
                     "op": "fleet.ack", "what": "pages", "ok": False,
-                    "gen": gen, "error": repr(e),
+                    "gen": gen, "error": _err_code(e),
                 }))])
             return  # distcheck: reply-ok(nack sent when a reply address exists)
         if reply:

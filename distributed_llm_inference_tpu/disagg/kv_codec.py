@@ -40,9 +40,22 @@ from ..distributed.relay import RelayClient
 __all__ = [
     "encode_kv", "decode_kv", "encode_error",
     "encode_session", "decode_session",
+    "SchemaError", "VERSION", "LAYOUTS",
 ]
 
-VERSION = 1
+# Codec schema version. v2 added the explicit ``layout`` header key
+# (value-cache vs latent stored form) — a v1 peer would misread a latent
+# transfer as k/v planes, so decoders REJECT any version other than this
+# one with :class:`SchemaError` (surfaced as a ``schema`` error reply by
+# the workers) instead of guessing.
+VERSION = 2
+
+# Stored-form layouts a transfer may declare: conventional per-head K/V
+# planes (``k``/``v`` + optional int8 scales) vs the latent (MLA) fused
+# form (``c`` + optional ``cs`` scales). The importer still validates
+# shapes/names against its own cache; the header key exists so skew is a
+# typed schema error at decode time, never a misparse.
+LAYOUTS = ("kv", "latent")
 
 # Header keys that must agree across every frame of one transfer.
 # ``op``/``session``/``att`` arrived with session migration (checkpoint
@@ -51,7 +64,16 @@ VERSION = 1
 # directions. ``att`` is the gateway's attempt tag: recovery consumers
 # fence frames whose tag predates the current attempt (zombie replies).
 _CONSISTENT = ("gens", "n", "n_valid", "first_token", "quant", "chain",
-               "ps", "crc", "total", "dtypes", "op", "session", "att")
+               "ps", "crc", "total", "dtypes", "op", "session", "att",
+               "layout")
+
+
+class SchemaError(ValueError):
+    """A frame whose schema this codec does not speak: unknown codec
+    version or undeclared/unknown stored-form layout. Distinct from the
+    plain ``ValueError`` integrity violations (loss, truncation, CRC)
+    so workers can answer with a ``schema`` error code — the peer's
+    fix is an upgrade, not a retry."""
 
 
 def _pack(header: dict, chunk: bytes = b"") -> bytes:
@@ -67,6 +89,15 @@ def _unpack(frame: bytes) -> Tuple[dict, bytes]:
         raise ValueError("kv frame truncated inside its header")
     header = json.loads(frame[4 : 4 + hlen].decode())
     return header, frame[4 + hlen :]
+
+
+def _layout_of(planes: Dict[str, "np.ndarray"]) -> str:
+    """Stored-form layout of a plane dict — ``"latent"`` when any plane
+    (bare or page-prefixed ``"<i>/<plane>"``) is a latent record."""
+    for name in planes:
+        if name.rpartition("/")[2] in ("c", "cs"):
+            return "latent"
+    return "kv"
 
 
 def _encode_plane(name: str, arr) -> bytes:
@@ -107,6 +138,7 @@ def encode_kv(
         chunks = [b""]
     header = {
         "v": VERSION,
+        "layout": _layout_of(planes),
         "gens": [gen_id],
         "n": len(chunks),
         "n_valid": int(n_valid),
@@ -138,18 +170,28 @@ def decode_kv(
 
     Returns ``(planes, meta)`` with ``meta["chain"]`` back as ``bytes``
     keys. An error frame returns ``(None, meta)`` with ``meta["error"]``
-    set. Raises ``ValueError`` on any integrity violation: version skew,
-    duplicate/missing/out-of-range frame index, inconsistent headers,
-    length or CRC mismatch, or a malformed plane record.
+    set. Raises :class:`SchemaError` (a ``ValueError`` subclass) on
+    version or layout skew, and plain ``ValueError`` on any other
+    integrity violation: duplicate/missing/out-of-range frame index,
+    inconsistent headers, length or CRC mismatch, or a malformed plane
+    record.
     """
     base: Optional[dict] = None
     chunks: Dict[int, bytes] = {}
     for frame in frames:
         header, chunk = _unpack(frame)
         if header.get("v") != VERSION:
-            raise ValueError(f"kv codec version skew: {header.get('v')!r}")
+            raise SchemaError(
+                f"unsupported kv codec version {header.get('v')!r} "
+                f"(this decoder speaks v{VERSION})"
+            )
         if "error" in header:
             return None, header
+        if header.get("layout") not in LAYOUTS:
+            raise SchemaError(
+                f"unknown kv stored-form layout {header.get('layout')!r} "
+                f"(known: {LAYOUTS})"
+            )
         i = header.get("i")
         if base is None:
             base = {k: header.get(k) for k in _CONSISTENT}
@@ -229,7 +271,7 @@ def encode_session(
         first_token=int(generated[-1]),
         chain=extra_chain,
         page_size=page_size,
-        quant="ks" in planes,
+        quant="ks" in planes or "cs" in planes,
         max_frame_bytes=max_frame_bytes,
         op=op,
         session=sess,
@@ -278,7 +320,7 @@ def encode_pages(
     planes: Dict[str, "np.ndarray"] = {}
     quant = False
     for i, (_, tiles) in enumerate(items):
-        quant = quant or "ks" in tiles
+        quant = quant or "ks" in tiles or "cs" in tiles
         for name, arr in tiles.items():
             planes[f"{i}/{name}"] = arr
     return encode_kv(

@@ -149,7 +149,7 @@ class PrefillWorker:
             frames = encode_kv(
                 gen, planes, len(prompt), first, chain,
                 page_size=self.engine.ccfg.page_size,
-                quant="ks" in planes,
+                quant="ks" in planes or "cs" in planes,
                 max_frame_bytes=int(
                     header.get("max_frame_bytes")
                     or self.dcfg.kv_frame_bytes

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..cache.base import window_ladder
 from ..cache.dense import DenseKVCache, QuantizedDenseKVCache
+from ..cache.latent import LatentPagedKVCache, QuantizedLatentPagedKVCache
 from ..cache.paged import PageAllocator, PagedKVCache, QuantizedPagedKVCache
 from ..cache.sink import QuantizedSinkKVCache, SinkKVCache
 
@@ -192,6 +193,23 @@ class InferenceEngine:
             raise ValueError(
                 f"prefix_caching requires the paged cache (got kind={cc.kind!r})"
             )
+        self._latent = cfg.use_latent
+        if self._latent:
+            # Latent (MLA) attention stores ONE low-rank [rank + dr] vector
+            # per token instead of per-head K/V — only the paged pool has
+            # the plane machinery (ingest/export/CoW/spill) wired for it,
+            # and the mesh programs shard per-head pools.
+            if cc.kind != "paged":
+                raise ValueError(
+                    "ModelConfig.latent requires the paged cache "
+                    f"(got kind={cc.kind!r})"
+                )
+            if mesh_cfg is not None:
+                raise ValueError(
+                    "latent KV attention is single-device only (mesh "
+                    "sharding of the latent pool is not implemented)"
+                )
+        self.plan.latent = self._latent
         if cc.kind == "dense":
             cache_cls = (
                 QuantizedDenseKVCache if cc.kv_quant == "int8" else DenseKVCache
@@ -236,16 +254,48 @@ class InferenceEngine:
                 max(1, -(-self._windows[0] // cc.page_size))
                 if self._windows else cc.max_pages_per_session
             )
-            paged_cls = (
-                QuantizedPagedKVCache if cc.kv_quant == "int8" else PagedKVCache
-            )
-            self.cache = paged_cls.create(
-                cfg.num_layers, b, cc.num_pages, cc.page_size,
-                self._first_slots, cfg.num_kv_heads, cfg.head_dim, dtype,
-                use_kernel=self._use_pallas,
-                use_ragged=_sel.use_ragged,
-            )
+            if self._latent:
+                # One shared latent "head" per token: the pool stores the
+                # fused [rank + rope_head_dim] stored form (f32, or int8 +
+                # f32 scales) and the kernels decompress in place via the
+                # same page-table walk (K = V = stored latent; the value
+                # up-projection happens past softmax in the model).
+                latent_cls = (
+                    QuantizedLatentPagedKVCache
+                    if cc.kv_quant == "int8" else LatentPagedKVCache
+                )
+                self.cache = latent_cls.create(
+                    cfg.num_layers, b, cc.num_pages, cc.page_size,
+                    self._first_slots, 1, cfg.latent.lat_dim,
+                    use_kernel=self._use_pallas,
+                    use_ragged=_sel.use_ragged,
+                )
+            else:
+                paged_cls = (
+                    QuantizedPagedKVCache
+                    if cc.kv_quant == "int8" else PagedKVCache
+                )
+                self.cache = paged_cls.create(
+                    cfg.num_layers, b, cc.num_pages, cc.page_size,
+                    self._first_slots, cfg.num_kv_heads, cfg.head_dim, dtype,
+                    use_kernel=self._use_pallas,
+                    use_ragged=_sel.use_ragged,
+                )
             self.allocator = PageAllocator(cc.num_pages)
+            # Stored KV footprint per token across all layers — the number
+            # the latent cache exists to shrink (bench.py --phase kvbytes
+            # reads it back for the latent-vs-baseline comparison).
+            self.metrics.gauge(
+                "kv_bytes_per_token",
+                float(sum(
+                    pool.shape[0] * pool.dtype.itemsize
+                    * math.prod(pool.shape[2:]) // cc.page_size
+                    for pool in (
+                        getattr(self.cache, f)
+                        for f in type(self.cache).PLANE_FIELDS.values()
+                    )
+                )),
+            )
             if cc.prefix_caching and self.pcfg.spill_bytes_max > 0:
                 # Host-DRAM spill tier (prefixstore/): registered prefix
                 # pages evicted by the refcount-aware LRU snapshot their
@@ -451,6 +501,10 @@ class InferenceEngine:
         tail_capable = (
             attention is None
             and not self._use_pp
+            # Latent caches have no tail protocol: the tail segment would
+            # re-apply RoPE to an already-decoupled stored form (tail_init
+            # raises by design). They scan model_apply per step instead.
+            and not isinstance(self.cache, LatentPagedKVCache)
             and (
                 isinstance(
                     self.cache,
@@ -913,6 +967,9 @@ class InferenceEngine:
             # tokens-per-round EMA sagging below the break-even band (high
             # acceptance never pays the probe's mode-switch cost).
             self._spec_suspended = False
+            # Injectable clock for the A/B controller — tests drive window
+            # wall time deterministically instead of sleeping through it.
+            self._spec_clock = time.monotonic
             self._spec_ctl = {
                 "mode": "spec", "win_t0": None, "win_tok0": 0.0,
                 "win_ticks": 0, "spec_rate": None, "plain_rate": None,
@@ -1488,13 +1545,28 @@ class InferenceEngine:
         value planes ``[L, S, Hkv, D]`` under ``"k"``/``"v"`` — bf16 (or
         engine dtype) for value caches, int8 for quantized ones, the
         latter alongside f32 scale planes ``[L, S, Hkv]`` under
-        ``"ks"``/``"vs"``. ``S = n`` tokens from position 0 — the default
-        ``len(s.prompt)`` covers the prompt (disagg prefill export);
-        session checkpoints pass ``total_len - 1`` to take the decoded
-        tail too. Keys are post-RoPE, as cached. Caller holds the
-        scheduler lock (or owns the engine)."""
+        ``"ks"``/``"vs"``. Latent (MLA) caches ship their stored form
+        instead: one fused latent plane ``[L, S, 1, rank + dr]`` under
+        ``"c"`` (f32, or int8 beside an f32 ``"cs"`` scale plane
+        ``[L, S, 1]``) — per-head K/V are never materialized, which is
+        what shrinks the disagg wire and migration checkpoints. ``S = n``
+        tokens from position 0 — the default ``len(s.prompt)`` covers the
+        prompt (disagg prefill export); session checkpoints pass
+        ``total_len - 1`` to take the decoded tail too. Keys are
+        post-RoPE, as cached. Caller holds the scheduler lock (or owns
+        the engine)."""
         n = len(s.prompt) if n is None else int(n)
         cache = self.cache
+        if isinstance(cache, LatentPagedKVCache):
+            pages = jnp.asarray(np.asarray(s.pages, np.int32))
+            a = jnp.transpose(cache.k_pages[:, pages], (0, 1, 3, 2, 4))
+            a = a.reshape(a.shape[0], -1, *a.shape[3:])
+            out = {"c": np.asarray(a[:, :n])}
+            if isinstance(cache, QuantizedLatentPagedKVCache):
+                sc = jnp.transpose(cache.cs_pages[:, pages], (0, 1, 3, 2))
+                sc = sc.reshape(sc.shape[0], -1, sc.shape[3])
+                out["cs"] = np.asarray(sc[:, :n])
+            return out
         if isinstance(cache, PagedKVCache):
             pages = jnp.asarray(np.asarray(s.pages, np.int32))
 
@@ -1529,6 +1601,67 @@ class InferenceEngine:
         raise ValueError(
             f"KV export unsupported for {type(cache).__name__}"
         )
+
+    def _check_planes(self, planes, n: int):
+        """Validate shipped KV planes against this cache's stored form and
+        return them as device arrays with a batch-1 axis inserted (the
+        shape :meth:`_ingest_row` wants). The plane-name set doubles as
+        the family/quantization handshake: value caches want ``k``/``v``
+        (+ ``ks``/``vs`` when int8), latent caches want ``c`` (+ ``cs``)
+        — a mismatch is a structural error, never a silent reinterpret."""
+        cache = self.cache
+        if isinstance(cache, QuantizedLatentPagedKVCache):
+            want = {"c", "cs"}
+        elif isinstance(cache, LatentPagedKVCache):
+            want = {"c"}
+        elif isinstance(
+            cache, (QuantizedPagedKVCache, QuantizedDenseKVCache)
+        ):
+            want = {"k", "v", "ks", "vs"}
+        else:
+            want = {"k", "v"}
+        if set(planes) != want:
+            raise ValueError(
+                f"KV planes {sorted(planes)} do not match this cache "
+                f"(want {sorted(want)}: cache family and quantization "
+                f"must agree across pools)"
+            )
+        if "c" in want:
+            shape = (self.cfg.num_layers, n, 1, self.cfg.latent.lat_dim)
+        else:
+            shape = (
+                self.cfg.num_layers, n,
+                self.cfg.num_kv_heads, self.cfg.head_dim,
+            )
+        for name in sorted(want):
+            expect = shape if name in ("c", "k", "v") else shape[:3]
+            got = tuple(np.asarray(planes[name]).shape)
+            if got != expect:
+                raise ValueError(
+                    f"KV plane {name!r} shape {got} != expected {expect}"
+                )
+        return {name: jnp.asarray(planes[name])[:, None] for name in want}
+
+    def _ingest_row(self, sub, dev, n: int, first_slot: int = 0):
+        """Scatter validated planes (from :meth:`_check_planes`) into a
+        batch-1 cache view, dispatching on the stored form."""
+        cache = self.cache
+        if isinstance(cache, LatentPagedKVCache):
+            return sub.ingest_latent_row(dev, n, first_slot=first_slot)
+        if isinstance(cache, QuantizedPagedKVCache):
+            return sub.ingest_planes_row(
+                dev["k"], dev["v"], dev["ks"], dev["vs"], n,
+                first_slot=first_slot,
+            )
+        if isinstance(cache, PagedKVCache):
+            return sub.ingest_row(
+                dev["k"], dev["v"], n, first_slot=first_slot
+            )
+        if isinstance(cache, QuantizedDenseKVCache):
+            return sub.ingest_planes_row(
+                dev["k"], dev["v"], dev["ks"], dev["vs"], n
+            )
+        return sub.ingest_row(dev["k"], dev["v"], n)
 
     def admit_prefilled(
         self,
@@ -1565,26 +1698,7 @@ class InferenceEngine:
         n = len(prompt)
         if n == 0:
             raise ValueError("empty prompt")
-        quant = isinstance(
-            self.cache, (QuantizedPagedKVCache, QuantizedDenseKVCache)
-        )
-        want = {"k", "v", "ks", "vs"} if quant else {"k", "v"}
-        if set(planes) != want:
-            raise ValueError(
-                f"KV planes {sorted(planes)} do not match this cache "
-                f"(want {sorted(want)}: quantization must agree across pools)"
-            )
-        shape = (
-            self.cfg.num_layers, n, self.cfg.num_kv_heads, self.cfg.head_dim,
-        )
-        for name in sorted(want):
-            expect = shape if name in ("k", "v") else shape[:3]
-            got = tuple(np.asarray(planes[name]).shape)
-            if got != expect:
-                raise ValueError(
-                    f"KV plane {name!r} shape {got} != expected {expect}"
-                )
-        dev = {name: jnp.asarray(planes[name])[:, None] for name in want}
+        dev = self._check_planes(planes, n)
         with self._lock:
             slot = next(
                 (i for i in range(self.batch) if self.slots[i] is None), None
@@ -1636,15 +1750,9 @@ class InferenceEngine:
                     self._flush_installs()  # the ingest scatter reads the table
                     if shared_len < n:
                         sub = self.cache.select_row(slot)
-                        if quant:
-                            sub = sub.ingest_planes_row(
-                                dev["k"], dev["v"], dev["ks"], dev["vs"], n,
-                                first_slot=len(shared),
-                            )
-                        else:
-                            sub = sub.ingest_row(
-                                dev["k"], dev["v"], n, first_slot=len(shared)
-                            )
+                        sub = self._ingest_row(
+                            sub, dev, n, first_slot=len(shared)
+                        )
                         self.cache = self.cache.merge_row(sub, slot)
                     else:
                         # Whole prompt served from shared pages: nothing to
@@ -1672,12 +1780,7 @@ class InferenceEngine:
                     raise
             else:
                 sub = self.cache.select_row(slot)
-                if quant:
-                    sub = sub.ingest_planes_row(
-                        dev["k"], dev["v"], dev["ks"], dev["vs"], n
-                    )
-                else:
-                    sub = sub.ingest_row(dev["k"], dev["v"], n)
+                sub = self._ingest_row(sub, dev, n)
                 self.cache = self.cache.merge_row(sub, slot)
             self.sessions[s.generation_id] = s
             s.slot = slot
@@ -1796,25 +1899,6 @@ class InferenceEngine:
             raise ValueError("snapshot already ended at eos")
         planes = snapshot["planes"]
         n = len(prompt) + len(generated) - 1
-        quant = isinstance(
-            self.cache, (QuantizedPagedKVCache, QuantizedDenseKVCache)
-        )
-        want = {"k", "v", "ks", "vs"} if quant else {"k", "v"}
-        if set(planes) != want:
-            raise ValueError(
-                f"KV planes {sorted(planes)} do not match this cache "
-                f"(want {sorted(want)}: quantization must agree across pools)"
-            )
-        shape = (
-            self.cfg.num_layers, n, self.cfg.num_kv_heads, self.cfg.head_dim,
-        )
-        for name in sorted(want):
-            expect = shape if name in ("k", "v") else shape[:3]
-            got = tuple(np.asarray(planes[name]).shape)
-            if got != expect:
-                raise ValueError(
-                    f"KV plane {name!r} shape {got} != expected {expect}"
-                )
         limit = (
             self.ecfg.max_seq_len
             if isinstance(self.cache, (DenseKVCache, QuantizedDenseKVCache))
@@ -1824,7 +1908,7 @@ class InferenceEngine:
             raise ValueError(
                 "snapshot exceeds this engine's per-session capacity"
             )
-        dev = {name: jnp.asarray(planes[name])[:, None] for name in want}
+        dev = self._check_planes(planes, n)
         with self._lock:
             slot = next(
                 (i for i in range(self.batch) if self.slots[i] is None), None
@@ -1858,12 +1942,7 @@ class InferenceEngine:
                         self._queue_install(slot, i, pg)
                     self._flush_installs()
                     sub = self.cache.select_row(slot)
-                    if quant:
-                        sub = sub.ingest_planes_row(
-                            dev["k"], dev["v"], dev["ks"], dev["vs"], n
-                        )
-                    else:
-                        sub = sub.ingest_row(dev["k"], dev["v"], n)
+                    sub = self._ingest_row(sub, dev, n)
                     self.cache = self.cache.merge_row(sub, slot)
                     if self.ccfg.prefix_caching:
                         # Only prompt-covered pages are content-addressable;
@@ -1878,12 +1957,7 @@ class InferenceEngine:
                     raise
             else:
                 sub = self.cache.select_row(slot)
-                if quant:
-                    sub = sub.ingest_planes_row(
-                        dev["k"], dev["v"], dev["ks"], dev["vs"], n
-                    )
-                else:
-                    sub = sub.ingest_row(dev["k"], dev["v"], n)
+                sub = self._ingest_row(sub, dev, n)
                 self.cache = self.cache.merge_row(sub, slot)
             self.sessions[s.generation_id] = s
             s.slot = slot
@@ -2637,16 +2711,18 @@ class InferenceEngine:
         if self.draft is None or not self.ecfg.speculative_adaptive:
             return
         c = self._spec_ctl
-        if not any(
-            g is not None and self._session_wants_spec(self.sessions[g])
+        nspec = sum(
+            1
             for g in self.slots
-        ):
+            if g is not None and self._session_wants_spec(self.sessions[g])
+        )
+        if nspec == 0:
             # Disengaged tick (no speculative sessions resident): the next
             # engaged window must NOT span this gap's wall time or its
             # non-speculative tokens.
             c["win_t0"] = None
             return
-        now = time.monotonic()
+        now = self._spec_clock()
         tokens = self._decode_tokens_total()
         comp = tuple(self.slots)
         if comp != c.get("comp"):
@@ -2675,8 +2751,18 @@ class InferenceEngine:
         c["win_ticks"] += 1
         if c["win_ticks"] < max(2, self.ecfg.speculative_probe_len):
             return
-        # Window boundary: fold this window's rate into the mode's EMA.
-        rate = (tokens - c["win_tok0"]) / max(now - c["win_t0"], 1e-9)
+        # Window boundary: fold this window's rate into the mode's EMA —
+        # normalized PER ACTIVE SPECULATIVE ROW. The composition reset
+        # above keeps ``nspec`` constant within a window, but consecutive
+        # windows can still run at different speculative occupancy (a spec
+        # session finished, a new one admitted between windows); comparing
+        # raw batch tokens/s across them would credit occupancy to the
+        # mode and latch the wrong path until the next probe.
+        rate = (
+            (tokens - c["win_tok0"])
+            / max(now - c["win_t0"], 1e-9)
+            / nspec
+        )
         mode = c["mode"]
         rkey = "plain_rate" if mode in ("probe_plain", "plain") else "spec_rate"
         c[rkey] = rate if c[rkey] is None else 0.5 * c[rkey] + 0.5 * rate

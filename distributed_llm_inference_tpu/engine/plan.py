@@ -110,6 +110,11 @@ class AttentionPlan:
             )
         self._credit = 0.0
         self._shapes = set()
+        # Set by the engine when the cache stores the latent (MLA) fused
+        # form: every dispatch then reads latents and decompresses in
+        # place via the page walk, which note_dispatch surfaces as the
+        # ``latent_decompress_dispatches`` counter.
+        self.latent = False
 
     # ------------------------------------------------------------------
     # Row classification / shape policy
@@ -231,6 +236,8 @@ class AttentionPlan:
                 self.metrics.counter("attn_recompiles")
         if self.metrics is None:
             return
+        if self.latent:
+            self.metrics.counter("latent_decompress_dispatches")
         if self.enabled and kind != DECODE:
             self.metrics.counter("attn_ragged_dispatches")
         if valid_tokens is not None:

@@ -36,7 +36,7 @@ from ..ops.attention import gqa_attention
 from ..ops.moe import moe_mlp
 from ..ops.norms import rms_norm
 from ..ops.quant import matmul as qmatmul
-from ..ops.rotary import RopeAngles, rope_cos_sin, rope_inv_freq
+from ..ops.rotary import RopeAngles, apply_rope, rope_cos_sin, rope_inv_freq
 
 Params = Dict[str, Any]
 
@@ -59,14 +59,34 @@ def init_layer_params(
             dtype
         )
 
-    p = {
-        "attn_norm": jnp.ones((num_layers, h), dtype),
-        "wq": w(keys[0], h, hq * d),
-        "wk": w(keys[1], h, hkv * d),
-        "wv": w(keys[2], h, hkv * d),
-        "wo": w(keys[3], hq * d, h),
-        "mlp_norm": jnp.ones((num_layers, h), dtype),
-    }
+    if cfg.use_latent:
+        # MLA (latent KV) attention parameter set — see
+        # :func:`_latent_attention` for how each projection is consumed.
+        lat = cfg.latent
+        dn = lat.nope_head_dim or d
+        dr = lat.rope_head_dim
+        p = {
+            "attn_norm": jnp.ones((num_layers, h), dtype),
+            "wq": w(keys[0], h, hq * (dn + dr)),
+            # Down-projection to the stored form: [c ; k_rope_pre].
+            "wkv_a": w(keys[1], h, lat.rank + dr),
+            "kv_norm": jnp.ones((num_layers, lat.rank), dtype),
+            # Key up-projection (absorbed into the query at apply time).
+            "wk_b": w(keys[2], lat.rank, hq, dn),
+            # Value up-projection (applied after the softmax).
+            "wv_b": w(keys[7], lat.rank, hq, d),
+            "wo": w(keys[3], hq * d, h),
+            "mlp_norm": jnp.ones((num_layers, h), dtype),
+        }
+    else:
+        p = {
+            "attn_norm": jnp.ones((num_layers, h), dtype),
+            "wq": w(keys[0], h, hq * d),
+            "wk": w(keys[1], h, hkv * d),
+            "wv": w(keys[2], h, hkv * d),
+            "wo": w(keys[3], hq * d, h),
+            "mlp_norm": jnp.ones((num_layers, h), dtype),
+        }
     if cfg.num_experts > 0:
         e = cfg.num_experts
         p["router"] = w(keys[7], h, e)
@@ -128,6 +148,15 @@ def _decoder_layer(
     hq, hkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    if cfg.use_latent:
+        attn_flat, new_state = _latent_attention(
+            cfg, p, h, layer_state, cache, rope, q_pos, num_new, attention_fn
+        )
+        o = qmatmul(attn_flat, p["wo"])
+        if "bo" in p:
+            o = o + p["bo"]
+        x = x + o
+        return _mlp_residual(cfg, p, x, s, num_new), new_state
     q = qmatmul(h, p["wq"])
     k = qmatmul(h, p["wk"])
     v = qmatmul(h, p["wv"])
@@ -148,7 +177,13 @@ def _decoder_layer(
     if "bo" in p:
         o = o + p["bo"]
     x = x + o
+    return _mlp_residual(cfg, p, x, s, num_new), new_state
 
+
+def _mlp_residual(cfg, p, x, s, num_new):
+    """Pre-norm MLP + residual (shared by the dense and latent attention
+    branches of :func:`_decoder_layer`)."""
+    b = x.shape[0]
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
     if cfg.num_experts > 0:
         # Bucket-padding positions (>= num_new) must not consume expert
@@ -162,7 +197,78 @@ def _decoder_layer(
         mlp = moe_mlp(cfg, p, h2, valid=valid)
     else:
         mlp = qmatmul(jax.nn.silu(qmatmul(h2, p["wg"])) * qmatmul(h2, p["wu"]), p["wd"])
-    return x + mlp, new_state
+    return x + mlp
+
+
+def _latent_attention(
+    cfg: ModelConfig,
+    p: Params,
+    h: jnp.ndarray,
+    layer_state: Tuple[jnp.ndarray, ...],
+    cache,
+    rope: RopeAngles,
+    q_pos: jnp.ndarray,
+    num_new: jnp.ndarray,
+    attention_fn=gqa_attention,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """Absorbed-MLA attention over the latent cache.
+
+    The cache stores ONE fused ``[c ; k_rope]`` latent per token (``c`` =
+    shared ``rank``-dim KV latent, ``k_rope`` = decoupled rotary key,
+    shared across heads). Two algebraic moves let attention run directly
+    over that stored form, so the kernels' page walk doubles as the
+    latent→K/V decompression (no per-token K/V ever materializes):
+
+    * The key up-projection is ABSORBED into the query:
+      ``q·k = q_nope·(w_uk c) = (q_nope w_uk)·c`` — so the query handed to
+      the cache is ``[q_nope @ w_uk[h] ; q_rope]`` and K is the latent
+      itself (one KV "head"; GQA broadcast covers all ``Hq`` heads).
+    * The value up-projection is DEFERRED past the softmax: with
+      ``V = [c ; k_rope]`` the attention output's first ``rank`` dims are
+      ``sum_j p_j c_j``, up-projected per head afterwards
+      (``sum_j p_j v_j = w_uv (sum_j p_j c_j)``).
+
+    Rope is applied here, to the rope slices only (``rope`` tables are
+    built for ``rope_head_dim`` — see :func:`block_apply`); the cache must
+    not rotate anything. Softmax scale is ``(dn + dr)**-0.5``, the
+    effective per-head query dim of the UN-absorbed formulation.
+    """
+    lat = cfg.latent
+    b, s, _ = h.shape
+    hq, d = cfg.num_heads, cfg.head_dim
+    dn = lat.nope_head_dim or d
+    dr = lat.rope_head_dim
+    rank = lat.rank
+
+    q = qmatmul(h, p["wq"]).reshape(b, s, hq, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    ckv = qmatmul(h, p["wkv_a"])  # [B, S, rank + dr]
+    c = rms_norm(ckv[..., :rank], p["kv_norm"], cfg.rms_norm_eps)
+    k_rope = apply_rope(
+        ckv[..., rank:][:, :, None, :], rope.cos, rope.sin
+    )  # [B, S, 1, dr]
+    q_rope = apply_rope(q_rope, rope.cos, rope.sin)
+    # Absorbed query: q_lat[b,s,h,r] = q_nope[b,s,h,:] · w_uk[r,h,:].
+    q_lat = jnp.einsum("bshd,rhd->bshr", q_nope, p["wk_b"])
+    q_eff = jnp.concatenate([q_lat, q_rope], axis=-1)  # [B, S, Hq, rank+dr]
+    kv = jnp.concatenate(
+        [c[:, :, None, :], k_rope], axis=-1
+    )  # [B, S, 1, rank+dr] — the STORED form the cache scatters verbatim
+    attn, new_state = cache.attend(
+        layer_state, q_eff, kv, kv, rope, q_pos, num_new,
+        None, attention_fn, (dn + dr) ** -0.5,
+    )
+    # Deferred value up-projection from the latent-space attention result.
+    o = jnp.einsum("bshr,rhd->bshd", attn[..., :rank], p["wv_b"])
+    return o.reshape(b, s, hq * d), new_state
+
+
+def _rope_dim(cfg: ModelConfig) -> int:
+    """Rotary table width: the decoupled rope key dim under latent (MLA)
+    attention — only that slice of q/k is rotated — else the head dim."""
+    return (
+        cfg.latent.rope_head_dim if cfg.use_latent else cfg.head_dim
+    )
 
 
 def _split_int4_stacks(layer_params: Params):
@@ -214,7 +320,7 @@ def block_apply(
     call ``cache.advance(num_new)`` after the last block of the model so that
     multiple blocks of one pipeline see consistent write offsets).
     """
-    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    inv_freq = rope_inv_freq(_rope_dim(cfg), cfg.rope_theta, cfg.rope_scaling)
     q_pos = cache.q_positions(x.shape[1])
     rot_pos = cache.rope_positions(x.shape[1], num_new)
     cos, sin = rope_cos_sin(rot_pos, inv_freq)
@@ -366,7 +472,7 @@ def multi_decode_apply(
     joint-merged with the tail — see ``cache/paged.py``); callers fall back
     to per-step ``model_apply`` for other caches.
     """
-    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    inv_freq = rope_inv_freq(_rope_dim(cfg), cfg.rope_theta, cfg.rope_scaling)
     # ``tail_big_stacks`` lets a cache hand the scan a DIFFERENT read-only
     # view of its big planes than its storage layout — the quantized paged
     # cache gathers its page pool to contiguous per-row buffers ONCE here
@@ -508,6 +614,24 @@ def convert_hf_layer(
         if transpose:
             arr = arr.T
         out[name] = arr.astype(jnp.dtype(dtype))
+    # MLA (DeepSeek-V2-style) latent attention: the joint kv_b_proj
+    # [Hq*(dn+dv), rank] splits into the key up-projection (absorbed into
+    # the query) and the value up-projection (applied post-softmax).
+    kvb_key = prefix + "self_attn.kv_b_proj.weight"
+    if cfg.use_latent and kvb_key in state:
+        lat = cfg.latent
+        dn = lat.nope_head_dim or cfg.head_dim
+        kvb = np.asarray(state[kvb_key]).T.reshape(
+            lat.rank, cfg.num_heads, dn + cfg.head_dim
+        )
+        out["wk_b"] = kvb[..., :dn].astype(jnp.dtype(dtype))
+        out["wv_b"] = kvb[..., dn:].astype(jnp.dtype(dtype))
+        akey = prefix + "self_attn.kv_a_proj_with_mqa.weight"
+        if akey in state:
+            out["wkv_a"] = np.asarray(state[akey]).T.astype(jnp.dtype(dtype))
+        nkey = prefix + "self_attn.kv_a_layernorm.weight"
+        if nkey in state:
+            out["kv_norm"] = np.asarray(state[nkey]).astype(jnp.dtype(dtype))
     # Mixtral MoE: gate (router) + per-expert w1/w3/w2 → stacked [E, …].
     gate_key = prefix + "block_sparse_moe.gate.weight"
     if gate_key in state and cfg.num_experts > 0:

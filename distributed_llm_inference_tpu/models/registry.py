@@ -16,6 +16,9 @@ Families:
   configs) tied embeddings.
 * ``mixtral`` — + MoE MLP (``num_experts``/``num_experts_per_tok``), expert
   parallelism over the ``ep`` mesh axis (``ops/moe.py``).
+* ``mla``     — latent (low-rank) KV attention (``ModelConfig.latent``):
+  DeepSeek-V2-style MLA with a shared per-token KV latent and a decoupled
+  rotary key, served through the latent paged cache (``cache/latent.py``).
 """
 
 from __future__ import annotations
@@ -38,6 +41,10 @@ class ModelFamily:
     sliding_window: bool = False
     qkv_bias: bool = False
     moe: bool = False
+    # Latent (MLA) KV attention: the family both permits AND requires
+    # ``ModelConfig.latent`` — the latent decoder path has its own
+    # projection set, so a family is one or the other, never both.
+    latent: bool = False
     # The compute/conversion program (shared stack for all current families).
     apply: Callable = llama.model_apply
     block_apply: Callable = llama.block_apply
@@ -52,6 +59,9 @@ FAMILIES: Dict[str, ModelFamily] = {
         ModelFamily("mistral", ("mistral",), sliding_window=True),
         ModelFamily("qwen2", ("qwen2",), sliding_window=True, qkv_bias=True),
         ModelFamily("mixtral", ("mixtral",), sliding_window=True, moe=True),
+        ModelFamily(
+            "mla", ("mla", "deepseek_v2", "deepseek_v3"), latent=True
+        ),
     )
 }
 
@@ -92,4 +102,13 @@ def validate_config(cfg: ModelConfig) -> ModelFamily:
         )
     if cfg.qkv_bias and not fam.qkv_bias:
         raise ValueError(f"family {fam.name!r} does not use qkv_bias")
+    if cfg.latent is not None and not fam.latent:
+        raise ValueError(
+            f"family {fam.name!r} does not use latent KV attention "
+            f"(use the 'mla' family)"
+        )
+    if fam.latent and (cfg.latent is None or not cfg.latent.enabled):
+        raise ValueError(
+            f"family {fam.name!r} requires an enabled ModelConfig.latent"
+        )
     return fam

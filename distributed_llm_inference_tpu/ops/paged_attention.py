@@ -53,6 +53,8 @@ from .attention import _NEG_INF
 __all__ = [
     "paged_attention",
     "quantized_paged_attention",
+    "latent_paged_attention",
+    "quantized_latent_paged_attention",
     "quantized_paged_fused_attention",
 ]
 
@@ -438,6 +440,51 @@ def quantized_paged_attention(
     if return_stats:
         return out, m[:, :, 0].reshape(b, hkv, g), l[:, :, 0].reshape(b, hkv, g)
     return out
+
+
+def latent_paged_attention(
+    q: jnp.ndarray,
+    c_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    q_positions: Optional[jnp.ndarray] = None,
+    return_stats: bool = False,
+):
+    """Absorbed-MLA decode attention over the latent pool, in place — the
+    non-ragged fallback of ``ops/ragged_attention.py:
+    latent_ragged_paged_attention`` (same contract: ``c_pages``
+    ``[P, 1, page_size, lat_dim]`` fused ``[c ; k_rope]`` latents, ``q``
+    the absorbed ``[B, 1, Hq, lat_dim]`` query, ``K = V =`` stored
+    latents, so the page walk is the decompression fusion)."""
+    return paged_attention(
+        q, c_pages, c_pages, page_table, kv_lengths, scale=scale,
+        sliding_window=sliding_window, interpret=interpret,
+        q_positions=q_positions, return_stats=return_stats,
+    )
+
+
+def quantized_latent_paged_attention(
+    q: jnp.ndarray,
+    c_pages: jnp.ndarray,
+    cs_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    q_positions: Optional[jnp.ndarray] = None,
+    return_stats: bool = False,
+):
+    """As :func:`latent_paged_attention` over the int8 latent pool with
+    per-token f32 scales (``cs_pages``: ``[P, 1, page_size]``)."""
+    return quantized_paged_attention(
+        q, c_pages, cs_pages, c_pages, cs_pages, page_table, kv_lengths,
+        scale=scale, sliding_window=sliding_window, interpret=interpret,
+        q_positions=q_positions, return_stats=return_stats,
+    )
 
 
 def quantized_paged_fused_attention(

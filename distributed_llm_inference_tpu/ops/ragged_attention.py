@@ -46,6 +46,8 @@ from .attention import _NEG_INF
 __all__ = [
     "ragged_paged_attention",
     "quantized_ragged_paged_attention",
+    "latent_ragged_paged_attention",
+    "quantized_latent_ragged_paged_attention",
     "ragged_attention_reference",
 ]
 
@@ -428,6 +430,61 @@ def quantized_ragged_paged_attention(
       q_start.astype(jnp.int32), num_new.astype(jnp.int32),
       qr, k_pages, ks_pages, v_pages, vs_pages)
     return out[:, :s].reshape(b, s, hq, d)
+
+
+def latent_ragged_paged_attention(
+    q: jnp.ndarray,
+    c_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    num_new: jnp.ndarray,
+    q_start: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_q: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Absorbed-MLA ragged attention reading the latent pool in place.
+
+    ``c_pages``: ``[P, 1, page_size, lat_dim]`` — one layer's pool of
+    fused ``[c ; k_rope]`` latents (f32, rope pre-applied to the rope
+    slice by the model); ``q``: the absorbed query ``[B, S, Hq,
+    lat_dim]``. Because the key up-projection is folded into ``q`` and
+    the value up-projection is deferred past the softmax
+    (``models/llama.py:_latent_decoder_layer``), attention runs with
+    ``K = V =`` the STORED latent: the kernel's existing page-table walk
+    IS the latent→K/V decompression fusion — no per-token K/V ever
+    materializes, on-chip or off. Output: ``[B, S, Hq, lat_dim]`` whose
+    first ``rank`` dims are the latent-space attention result.
+    """
+    return ragged_paged_attention(
+        q, c_pages, c_pages, page_table, kv_lengths, num_new,
+        q_start=q_start, scale=scale, sliding_window=sliding_window,
+        block_q=block_q, interpret=interpret,
+    )
+
+
+def quantized_latent_ragged_paged_attention(
+    q: jnp.ndarray,
+    c_pages: jnp.ndarray,
+    cs_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    num_new: jnp.ndarray,
+    q_start: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_q: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """As :func:`latent_ragged_paged_attention` over the int8 latent pool
+    (``cs_pages``: ``[P, 1, page_size]`` per-token f32 scales); the int8
+    pages stream through VMEM as-is and dequantize on the scores."""
+    return quantized_ragged_paged_attention(
+        q, c_pages, cs_pages, c_pages, cs_pages, page_table, kv_lengths,
+        num_new, q_start=q_start, scale=scale,
+        sliding_window=sliding_window, block_q=block_q, interpret=interpret,
+    )
 
 
 def ragged_attention_reference(

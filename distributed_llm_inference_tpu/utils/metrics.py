@@ -68,6 +68,11 @@ METRICS = {
     "decode_resolve": ("summary", "Deferred decode fetch latency"),
     "decode_tokens": ("counter", "Tokens emitted by decode"),
     "cache_growths": ("counter", "KV cache reallocations"),
+    # latent (MLA) KV compression (cache/latent.py)
+    "kv_bytes_per_token": ("gauge", "Stored KV bytes per token, all layers"),
+    "latent_decompress_dispatches": (
+        "counter", "Attention dispatches reading the latent stored form"
+    ),
     # engine: speculative decoding
     "spec_adapt_window_resets": ("counter", "Adaptive-k A/B window resets"),
     "spec_adapt_probes": ("counter", "Adaptive-k probe windows started"),

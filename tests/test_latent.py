@@ -1,0 +1,410 @@
+"""Latent (MLA) KV compression suite.
+
+The latent cache stores ONE fused ``[rank + rope_head_dim]`` record per
+token instead of per-head K/V planes — a different model family
+(``mla``), not a lossy re-encoding of a value cache. These tests pin the
+contracts the rest of the stack leans on:
+
+* **registry gate** — ``LatentConfig`` is rejected outside the ``mla``
+  family, and ``mla`` requires it enabled;
+* **determinism** — same config + seed ⇒ identical tokens, greedy and
+  sampled, f32 and int8 stored forms;
+* **accounting** — ``kv_bytes_per_token`` reports the latent stored
+  form's true footprint and attention dispatches count
+  ``latent_decompress_dispatches``;
+* **migration** — ``export_session`` snapshots the latent stored form
+  (``c``/``cs`` planes, never per-head K/V) and the codec round-trip
+  resumes BYTE-EXACT on a fresh engine;
+* **spill tier** — evict → host arena → reload is bit-exact under the
+  latent cache (the arena is layout-agnostic: it round-trips whatever
+  plane dict ``read_page`` hands it);
+* **disagg** — ``prefill_export`` → ``encode_kv`` (header declares
+  ``layout: "latent"``) → ``admit_prefilled`` on a latent decode engine
+  matches the colocated stream; cross-family plane dicts are rejected
+  on import;
+* **wire schema** — decoders reject stale codec versions and unknown
+  layouts with :class:`SchemaError`, which workers surface as a
+  ``schema`` error reply (upgrade, not retry);
+* **spec A/B normalization** — ``_spec_adapt`` folds windows as
+  tokens/s PER ACTIVE SPECULATIVE ROW, so occupancy changes between
+  windows cannot latch the wrong mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llm_inference_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    LatentConfig,
+    ModelConfig,
+    PrefixConfig,
+)
+from distributed_llm_inference_tpu.disagg.kv_codec import (
+    SchemaError,
+    _pack,
+    _unpack,
+    decode_kv,
+    decode_session,
+    encode_error,
+    encode_kv,
+    encode_session,
+)
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.sampling import SamplingOptions
+from distributed_llm_inference_tpu.models import llama
+from distributed_llm_inference_tpu.models.registry import validate_config
+
+pytestmark = pytest.mark.latent
+
+MLA_CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=1, head_dim=16, family="mla",
+    latent=LatentConfig(rank=16, rope_head_dim=8),
+)
+LAT_DIM = MLA_CFG.latent.lat_dim  # 24
+BASE_CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=160, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16,
+)
+
+_PARAMS = {}
+
+
+def _params(cfg):
+    key = cfg.family
+    if key not in _PARAMS:
+        _PARAMS[key] = llama.init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.float32
+        )
+    return _PARAMS[key]
+
+
+PS = 8
+
+
+def make_engine(cfg=MLA_CFG, kv_quant=None, num_pages=64, prefix=False,
+                spill=0, batch=2, seed=1, **ekw):
+    return InferenceEngine(
+        cfg, _params(cfg),
+        EngineConfig(max_batch_size=batch, prefill_buckets=(8, 16, 32),
+                     max_seq_len=128, dtype="float32", **ekw),
+        CacheConfig(kind="paged", kv_quant=kv_quant, page_size=PS,
+                    num_pages=num_pages, max_pages_per_session=16,
+                    prefix_caching=prefix),
+        rng=jax.random.PRNGKey(seed),
+        prefix_cfg=(
+            PrefixConfig(prefix_share=True, spill_bytes_max=spill)
+            if prefix else None
+        ),
+    )
+
+
+def drain(engine, gid, budget=200):
+    toks = []
+    for _ in range(budget):
+        for g, tok, fin in engine.step():
+            if g != gid:
+                continue
+            if tok >= 0:
+                toks.append(tok)
+            if fin:
+                return toks
+    raise AssertionError("generation did not finish in budget")
+
+
+def run_partway(engine, gid, min_tokens):
+    got = []
+    for _ in range(200):
+        if len(got) >= min_tokens:
+            return got
+        for g, tok, fin in engine.step():
+            if g != gid:
+                continue
+            if tok >= 0:
+                got.append(tok)
+            assert not fin, "session finished before the export point"
+    raise AssertionError("engine stalled before the export point")
+
+
+QUANTS = [None, "int8"]
+
+
+# -- registry gate ------------------------------------------------------------
+
+
+def test_registry_gates_latent_config():
+    validate_config(MLA_CFG)  # the blessed combination
+    import dataclasses as dc
+
+    with pytest.raises(ValueError, match="latent"):
+        validate_config(dc.replace(BASE_CFG, latent=MLA_CFG.latent))
+    with pytest.raises(ValueError, match="latent"):
+        validate_config(dc.replace(MLA_CFG, latent=None))
+
+
+def test_latent_requires_paged_cache():
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(
+            MLA_CFG, _params(MLA_CFG),
+            EngineConfig(max_batch_size=2, prefill_buckets=(8,),
+                         max_seq_len=64, dtype="float32"),
+            CacheConfig(kind="dense"),
+        )
+
+
+# -- determinism + accounting -------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", QUANTS)
+@pytest.mark.parametrize("temp", [0.0, 0.8])
+def test_latent_decode_deterministic(kv_quant, temp):
+    """Same config + seed ⇒ identical tokens (greedy AND sampled): the
+    latent path consumes RNG keys exactly like the baseline engine."""
+    prompt = [3, 5, 7, 11, 13]
+    opts = SamplingOptions(temperature=temp, top_k=20 if temp else 0,
+                           max_new_tokens=12)
+    a = make_engine(kv_quant=kv_quant).generate([prompt], opts)[0]
+    b = make_engine(kv_quant=kv_quant).generate([prompt], opts)[0]
+    assert a == b and len(a) == 12
+
+
+@pytest.mark.parametrize("kv_quant,bpt", [
+    (None, 2 * LAT_DIM * 4),          # L * lat_dim * f32
+    ("int8", 2 * (LAT_DIM + 4)),      # L * (int8 latent + f32 scale)
+])
+def test_latent_kv_bytes_per_token_gauge(kv_quant, bpt):
+    eng = make_engine(kv_quant=kv_quant)
+    assert eng.metrics.get_gauge("kv_bytes_per_token") == bpt
+    # Baseline at the same geometry for scale: K+V * Hkv * D * 4 per layer.
+    base = make_engine(BASE_CFG)
+    assert base.metrics.get_gauge("kv_bytes_per_token") == 2 * 2 * 2 * 16 * 4
+    eng.generate([[3, 5, 7]], SamplingOptions(max_new_tokens=4))
+    assert eng.metrics.get_counter("latent_decompress_dispatches") > 0
+    assert base.metrics.get_counter("latent_decompress_dispatches") == 0
+
+
+# -- ragged kernel path + chunked admission -----------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", QUANTS)
+def test_latent_ragged_parity(kv_quant):
+    """The ragged mixed-phase kernel path reads the latent stored form
+    through the same page-table walk (K = V = latent): byte-exact vs the
+    non-ragged latent fallback."""
+    ps = [[3, 5, 7], [11, 13, 17, 19, 23], [2, 4, 6, 8]]
+    opts = SamplingOptions(max_new_tokens=5)
+    base = make_engine(kv_quant=kv_quant, batch=4,
+                       ragged_attention=False).generate(ps, opts)
+    rag = make_engine(kv_quant=kv_quant, batch=4,
+                      ragged_attention=True).generate(ps, opts)
+    assert base == rag
+
+
+def test_latent_chunked_admission_parity():
+    """A long greedy prompt chunk-admitted beside live latent decode rows
+    still produces the non-chunked stream."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    mix = [[3, 5, 7], rng.integers(0, 128, size=30).tolist(), [2, 4, 6]]
+    opts = SamplingOptions(max_new_tokens=6)
+    base = make_engine(batch=4, ragged_attention=False).generate(mix, opts)
+    eng = make_engine(batch=4, ragged_attention=True,
+                      prefill_chunk_tokens=8, chunk_decode_share=0.5)
+    assert eng.generate(mix, opts) == base
+    assert eng.metrics.get_counter("attn_chunked_rows") > 0
+
+
+# -- migration: latent stored form through the codec --------------------------
+
+
+@pytest.mark.parametrize("kv_quant,temp", [
+    (None, 0.0), (None, 0.8), ("int8", 0.0), ("int8", 0.8),
+])
+def test_latent_export_resume_byte_exact(kv_quant, temp):
+    """Checkpoint mid-decode, ship through ``encode_session``, resume on
+    a FRESH latent engine: continuation equals the uninterrupted stream
+    bit for bit, and the snapshot carries the latent STORED form (one
+    fused ``[lat_dim]`` record per token, never per-head K/V)."""
+    prompt = [3, 5, 7, 11, 13]
+    opts = SamplingOptions(temperature=temp, top_k=20 if temp else 0,
+                           max_new_tokens=24)
+    ref = make_engine(kv_quant=kv_quant)
+    base = drain(ref, ref.submit(list(prompt), opts))
+
+    victim = make_engine(kv_quant=kv_quant)
+    gid = victim.submit(list(prompt), opts)
+    run_partway(victim, gid, 6)
+    snap = victim.export_session(gid)
+    assert snap is not None
+    want = {"c", "cs"} if kv_quant else {"c"}
+    assert set(snap["planes"]) == want
+    assert snap["planes"]["c"].shape[-1] == LAT_DIM
+
+    frames = encode_session("mig", snap, page_size=PS)
+    snap2, meta = decode_session(frames)
+    assert meta["layout"] == "latent"
+
+    dst = make_engine(kv_quant=kv_quant)
+    gid2 = dst.resume_session(snap2)
+    assert snap["generated"] + drain(dst, gid2) == base
+
+
+# -- spill tier ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", QUANTS)
+def test_latent_spill_reload_round_trip(kv_quant):
+    """Pressure-evict latent prefix pages to the host arena and reload
+    them: streams stay byte-exact vs an unshared latent engine (the
+    arena round-trips the latent plane dict bit for bit)."""
+    opts = SamplingOptions(max_new_tokens=4, eos_token_id=-1)
+    pA, pB = list(range(1, 18)), list(range(50, 74))
+    e = make_engine(kv_quant=kv_quant, prefix=True, spill=1 << 20,
+                    num_pages=6)  # 5 usable pages: B evicts A
+    rA = e.generate([pA], opts)[0]
+    rB = e.generate([pB], opts)[0]
+    snap = e.metrics.snapshot()
+    assert snap.get("prefix_spilled_pages", 0) >= 1
+    rA2 = e.generate([pA], opts)[0]
+    snap = e.metrics.snapshot()
+    assert snap.get("prefix_spill_reloads", 0) >= 1
+    assert snap.get("prefix_reload_errors", 0) == 0
+    s = make_engine(kv_quant=kv_quant, num_pages=32)
+    assert [rA, rB, rA2] == [
+        s.generate([p], opts)[0] for p in (pA, pB, pA)
+    ]
+
+
+# -- disaggregated admission --------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", QUANTS)
+def test_latent_disagg_admit_byte_exact(kv_quant):
+    """prefill_export on a latent engine → codec (header declares the
+    latent layout) → admit_prefilled on a fresh latent engine: the
+    decoded stream equals the colocated run token for token."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+    opts = SamplingOptions(max_new_tokens=6)
+    base = make_engine(kv_quant=kv_quant).generate([prompt], opts)[0]
+    src = make_engine(kv_quant=kv_quant)
+    dst = make_engine(kv_quant=kv_quant)
+    planes, first, chain = src.prefill_export(list(prompt), opts)
+    frames = encode_kv("ship", planes, len(prompt), first, chain,
+                       page_size=PS, quant="cs" in planes,
+                       max_frame_bytes=2048)
+    dec, meta = decode_kv(frames)
+    assert meta["layout"] == "latent"
+    assert meta["quant"] is bool(kv_quant)
+    gid = dst.admit_prefilled(list(prompt), dec, meta["first_token"],
+                              options=opts)
+    assert drain(dst, gid) == base
+
+
+def test_cross_family_planes_rejected():
+    """A latent engine must refuse per-head K/V planes and vice versa —
+    silently ingesting the wrong stored form would corrupt decode."""
+    prompt = [1, 2, 3, 4, 5]
+    opts = SamplingOptions(max_new_tokens=4)
+    kv_planes, kv_first, _ = make_engine(BASE_CFG).prefill_export(
+        list(prompt), opts)
+    lat_planes, lat_first, _ = make_engine().prefill_export(
+        list(prompt), opts)
+    with pytest.raises(ValueError, match="cache family"):
+        make_engine().admit_prefilled(list(prompt), kv_planes, kv_first,
+                                      options=opts)
+    with pytest.raises(ValueError, match="cache family"):
+        make_engine(BASE_CFG).admit_prefilled(list(prompt), lat_planes,
+                                              lat_first, options=opts)
+
+
+# -- wire schema versioning ---------------------------------------------------
+
+
+def _tamper(frame, **header_updates):
+    header, chunk = _unpack(frame)
+    header.update(header_updates)
+    return _pack(header, chunk)
+
+
+def test_codec_rejects_stale_version():
+    """A v1 peer's frame (no layout vocabulary) must fail TYPED at decode
+    — a SchemaError, never a misparse of latent planes as K/V."""
+    planes, first, chain = make_engine().prefill_export(
+        [1, 2, 3, 4, 5], SamplingOptions(max_new_tokens=4))
+    frames = encode_kv("g", planes, 5, first, chain, page_size=PS)
+    stale = [_tamper(f, v=1) for f in frames]
+    with pytest.raises(SchemaError, match="version"):
+        decode_kv(stale)
+    # ... and an unknown layout tag fails the same way.
+    alien = [_tamper(f, layout="holographic") for f in frames]
+    with pytest.raises(SchemaError, match="layout"):
+        decode_kv(alien)
+    # Untampered frames still round-trip, and error frames (which carry
+    # no layout) still decode as error replies.
+    dec, meta = decode_kv(frames)
+    assert meta["layout"] == "latent"
+    err, emeta = decode_kv([encode_error("g", "boom")])
+    assert err is None and emeta["error"] == "boom"
+
+
+def test_schema_error_maps_to_schema_reply_code():
+    """Workers answer schema skew with the typed ``schema`` error code
+    (the fix is an upgrade, not a retry) — everything else keeps the
+    repr() diagnostic."""
+    from distributed_llm_inference_tpu.disagg.decode_node import _err_code
+
+    assert _err_code(SchemaError("unsupported kv codec version")) == "schema"
+    assert _err_code(ValueError("crc mismatch")) == repr(
+        ValueError("crc mismatch"))
+
+
+# -- speculative A/B normalization --------------------------------------------
+
+
+def test_spec_adapt_normalizes_per_spec_row():
+    """Two windows at different speculative occupancy but identical
+    per-row throughput must fold to the SAME rate: the controller
+    normalizes by active speculative rows, so batch occupancy cannot
+    masquerade as a mode speedup."""
+    eng = InferenceEngine(
+        BASE_CFG, _params(BASE_CFG),
+        EngineConfig(max_batch_size=4, prefill_buckets=(8,),
+                     max_seq_len=64, dtype="float32", speculative_k=2,
+                     speculative_adaptive=True, speculative_probe_len=2),
+        CacheConfig(kind="dense"),
+        draft=(BASE_CFG, _params(BASE_CFG)),
+    )
+    clock = {"t": 0.0}
+    tokens = {"n": 0.0}
+    eng._spec_clock = lambda: clock["t"]
+    eng._decode_tokens_total = lambda: tokens["n"]
+    eng._session_wants_spec = lambda s: True
+
+    def window(nspec, tok_per_row):
+        """Drive one full measurement window at ``nspec`` occupancy."""
+        eng.slots = [f"g{i}" for i in range(nspec)] + [None]
+        eng.sessions = {f"g{i}": object() for i in range(nspec)}
+        c = eng._spec_ctl
+        c["comp"] = tuple(eng.slots)  # composition stable within window
+        c.update(win_t0=clock["t"], win_tok0=tokens["n"], win_ticks=0,
+                 stat0=dict(eng.spec_stats), skip=0)
+        for _ in range(2):  # probe_len=2 ticks close the window
+            clock["t"] += 1.0
+            tokens["n"] += nspec * tok_per_row
+            eng._spec_adapt([])
+        return eng._spec_ctl["spec_rate"]
+
+    r1 = window(nspec=3, tok_per_row=5.0)
+    assert r1 == pytest.approx(5.0)  # tokens/s PER ROW, not 15.0 batch-wide
+    eng._spec_ctl["spec_rate"] = None  # independent second measurement
+    r2 = window(nspec=1, tok_per_row=5.0)
+    assert r2 == pytest.approx(r1)  # occupancy change ⇒ same normalized rate
+
+    # Full disengagement resets the window baseline.
+    eng.slots = [None] * 4
+    eng.sessions = {}
+    eng._spec_adapt([])
+    assert eng._spec_ctl["win_t0"] is None

@@ -61,6 +61,15 @@
 # correctness gate for zero-loss pool reshapes — the drain e2e combos
 # are the licence for fencing a live node at all. They ride the disagg
 # block at the end of the schedule (~90 s of the budget on CPU).
+# The latent (MLA) KV-compression contract tests (tests/test_latent.py,
+# marked 'latent': registry gating, deterministic decode across
+# greedy/sampled x f32/int8, byte-exact latent-stored-form migration and
+# spill→reload, disagg admit onto a latent engine, kv_codec version/
+# layout schema rejection, and the spec A/B per-row normalization unit)
+# are deliberately NOT marked 'slow': they are the correctness gate for
+# shipping ONE fused latent per token over every KV surface — the
+# byte-exact cases are what licenses the mla family at all (~60 s on
+# CPU).
 # The attention-plan contract tests (tests/test_attention_plan.py:
 # ragged kernel vs reference oracle under interpret mode, AttentionPlan
 # shape/classify/credit unit contracts, byte-exact ragged-vs-bucketed
