@@ -2067,6 +2067,9 @@ def _prefix_phase() -> dict:
 
 # Arrival shape for `--phase traffic`, settable via `--arrival` (see main()).
 _ARRIVAL = "poisson"
+# `--trace N` (traffic phase): enable gateway tracing and dump the N
+# slowest requests' stitched cross-node traces with the phase record.
+_TRACE_N = 0
 
 
 def _rate_envelope(shape: str, t: float, window_s: float) -> float:
@@ -2118,6 +2121,7 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
                 "scope": "cpu-localhost"}
     from distributed_llm_inference_tpu.config import (
         CacheConfig, EngineConfig, ModelConfig, SchedConfig, ServingConfig,
+        TraceConfig,
     )
     from distributed_llm_inference_tpu.engine.engine import InferenceEngine
     from distributed_llm_inference_tpu.models import llama as llama_mod
@@ -2145,6 +2149,10 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
         server = ApiServer(
             backend, scfg,
             sched_cfg=SchedConfig() if sched_on else None,
+            # `--trace N`: sample every request so the N slowest have
+            # stitched traces to dump; off otherwise (the default bench
+            # measures the zero-cost disabled path).
+            trace_cfg=TraceConfig() if _TRACE_N > 0 else None,
         )
         server.start()
         # Untimed warm-up: compile every prefill bucket + the decode step
@@ -2175,6 +2183,9 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
             )
             resp = conn.getresponse()
             rec["status"] = resp.status
+            tid = resp.getheader("x-trace-id")
+            if tid:
+                rec["trace_id"] = tid
             if resp.status != 200:
                 rec["code"] = json.loads(resp.read()).get(
                     "error", {}).get("code")
@@ -2238,7 +2249,33 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
         work.sort(key=lambda w: w[0])
         return work
 
-    def run_traffic(sched_on, include_batch, seed=1234):
+    trace_dumps = []  # `--trace N`: stitched traces of the slowest requests
+
+    def _dump_slow_traces(recs, port):
+        """Fetch the N slowest requests' stitched traces off the still-
+        running gateway (`/debug/trace/<id>`) before it shuts down."""
+        slow = sorted(
+            (r for r in recs if "ttft" in r and r.get("trace_id")),
+            key=lambda r: r["ttft"], reverse=True,
+        )[:_TRACE_N]
+        for r in slow:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=10.0)
+                conn.request("GET", f"/debug/trace/{r['trace_id']}")
+                resp = conn.getresponse()
+                doc = json.loads(resp.read()) if resp.status == 200 else {
+                    "error": resp.status}
+                conn.close()
+            except Exception as e:
+                doc = {"error": repr(e)[:80]}
+            trace_dumps.append({
+                "trace_id": r["trace_id"], "user": r["user"],
+                "ttft_ms": round(r["ttft"] * 1e3, 1), "trace": doc,
+            })
+
+    def run_traffic(sched_on, include_batch, seed=1234,
+                    collect_traces=False):
         server, backend = start_server(sched_on)
         try:
             work = make_workload(seed, include_batch)
@@ -2260,6 +2297,8 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
             for th in threads:
                 th.join(timeout=60.0)
             snap = backend.metrics.snapshot()
+            if collect_traces and _TRACE_N > 0:
+                _dump_slow_traces(recs, server.port)
         finally:
             server.request_shutdown()
             server.join(timeout=60.0)
@@ -2310,7 +2349,8 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
     # Run 2 — both tenants, legacy FIFO admission (scheduler off).
     fifo_recs, fifo_snap = run_traffic(sched_on=False, include_batch=True)
     # Run 3 — both tenants, scheduler on: weighted-fair lanes + shedding.
-    sched_recs, sched_snap = run_traffic(sched_on=True, include_batch=True)
+    sched_recs, sched_snap = run_traffic(sched_on=True, include_batch=True,
+                                         collect_traces=True)
 
     def summarize(recs, snap):
         chat = tenant_stats(recs, "chat", slo_s)
@@ -2334,7 +2374,9 @@ def _traffic_phase(arrival: str = "poisson") -> dict:
     sched = summarize(sched_recs, sched_snap)
     solo_p99 = solo["ttft_ms_p99"] or 1e-9
     sched_p99 = sched["chat"]["ttft_ms_p99"] or 0.0
+    extra = {"slow_traces": trace_dumps} if _TRACE_N > 0 else {}
     return {
+        **extra,
         "scope": "cpu-localhost", "window_s": WINDOW_S,
         "arrival": arrival,
         # One gateway+engine for the whole window: the node-count
@@ -2735,6 +2777,9 @@ def main():
         if "--arrival" in sys.argv:  # poisson | bursty | diurnal
             global _ARRIVAL
             _ARRIVAL = sys.argv[sys.argv.index("--arrival") + 1]
+        if "--trace" in sys.argv:  # dump the N slowest requests' traces
+            global _TRACE_N
+            _TRACE_N = int(sys.argv[sys.argv.index("--trace") + 1])
         print(json.dumps(run_phase(sys.argv[sys.argv.index("--phase") + 1])))
         return
 

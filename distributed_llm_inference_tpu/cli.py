@@ -17,6 +17,9 @@ Subcommands map onto the deployment roles:
                 ``api --disagg`` gateways over the relay
 * ``chaos``     fault-injecting TCP proxy in front of a relay hub: point
                 endpoints at its port and replay a seeded failure schedule
+* ``trace``     fetch one request's stitched cross-node trace (Chrome
+                trace-event JSON) from a gateway's ``/debug/trace/<id>``,
+                or the engine flight-recorder ring from ``/debug/ticks``
 * ``info``      inspect a checkpoint (config, layer count, shard files)
 * ``check``     run the ``tools.distcheck`` static analyzer over the
                 package (lock discipline, event-loop lints, PRNG/host-sync
@@ -35,6 +38,8 @@ Examples::
     distribute api --model /ckpt/llama --port 8000 --relay :18900 --disagg
     distribute chaos --upstream :18900 --port 18901 --seed 7 \\
         --fault 'drop:block.*:put:after=5,count=2' --fault 'sever:*:any'
+    distribute trace --url http://127.0.0.1:8000 4f2a9c1d3b5e7a90
+    distribute trace --url http://127.0.0.1:8000 --ticks
 """
 
 from __future__ import annotations
@@ -363,6 +368,7 @@ def cmd_api(args) -> int:
         EngineConfig,
         SchedConfig,
         ServingConfig,
+        TraceConfig,
     )
     from .serving import ApiServer, ClientBackend, DisaggBackend, EngineBackend
     from .utils import checkpoint
@@ -412,6 +418,9 @@ def cmd_api(args) -> int:
             shed_headroom=args.sched_shed_headroom,
             max_lane_depth=args.sched_max_lane_depth,
         )
+    trace_cfg = None if args.no_trace else TraceConfig(
+        trace_sample_rate=args.trace_sample_rate,
+    )
     if args.disagg:
         # Disaggregated serving: the local engine is the DECODE pool
         # member; prompt prefill routes to role="prefill" workers (the
@@ -432,6 +441,7 @@ def cmd_api(args) -> int:
                 quantization=args.quantize,
             ),
             CacheConfig(kind=args.cache, kv_quant=args.kv_quant),
+            trace_cfg=trace_cfg,
         )
         backend = DisaggBackend(
             engine, port, relay_host=host,
@@ -472,10 +482,11 @@ def cmd_api(args) -> int:
                 quantization=args.quantize,
             ),
             CacheConfig(kind=args.cache, kv_quant=args.kv_quant),
+            trace_cfg=trace_cfg,
         )
         backend = EngineBackend(engine, idle_sleep_s=scfg.idle_sleep_s)
     server = ApiServer(backend, scfg, tokenizer=tokenizer,
-                       sched_cfg=sched_cfg)
+                       sched_cfg=sched_cfg, trace_cfg=trace_cfg)
     server.serve_forever(ready_cb=lambda port: print(
         json.dumps({"event": "api_up", "port": port}), flush=True
     ))
@@ -548,6 +559,41 @@ def cmd_fleet(args) -> int:
         return 1
     finally:
         ctl.close()
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Fetch a stitched cross-node trace (``/debug/trace/<id>``, Chrome
+    trace-event JSON — load it in ``chrome://tracing`` or Perfetto) or the
+    engine flight-recorder ring (``/debug/ticks``) from a running
+    gateway. The trace id is the ``X-Trace-Id`` header every sampled
+    completion response carries."""
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if not args.ticks and not args.trace_id:
+        print("trace: a trace id is required (or pass --ticks)",
+              file=sys.stderr)
+        return 2
+    path = "/debug/ticks" if args.ticks else f"/debug/trace/{args.trace_id}"
+    try:
+        with urllib.request.urlopen(base + path, timeout=args.timeout) as r:
+            body = r.read().decode()
+    except urllib.error.HTTPError as e:
+        print(f"trace: {base + path} -> {e.code} {e.reason}",
+              file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"trace: {base + path} unreachable: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body)
+        print(json.dumps({"event": "trace_written", "path": args.out,
+                          "bytes": len(body)}), flush=True)
+    else:
+        print(body, flush=True)
     return 0
 
 
@@ -815,6 +861,14 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--sched-max-lane-depth", type=int, default=256,
                    help="pending tickets allowed per lane before "
                         "queue-full 429s")
+    a.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of requests minted a distributed-trace "
+                        "context (X-Trace-Id response header; stitched "
+                        "trace at /debug/trace/<id>)")
+    a.add_argument("--no-trace", action="store_true",
+                   help="disable distributed tracing AND the engine "
+                        "flight recorder entirely (no recorder "
+                        "allocation, /debug routes 404)")
     a.set_defaults(fn=cmd_api)
 
     c = sub.add_parser(
@@ -857,6 +911,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "reach zero before fencing anyway (stragglers "
                          "re-home via crash recovery, still exactly-once)")
     fl.set_defaults(fn=cmd_fleet)
+
+    tr = sub.add_parser(
+        "trace",
+        help="fetch a stitched cross-node request trace (Chrome "
+             "trace-event JSON) or the flight-recorder tick ring from a "
+             "gateway's debug endpoints",
+    )
+    tr.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (the X-Trace-Id response header)")
+    tr.add_argument("--url", required=True,
+                    help="gateway base URL, e.g. http://127.0.0.1:8000")
+    tr.add_argument("--ticks", action="store_true",
+                    help="fetch /debug/ticks (per-tick engine flight "
+                         "recorder) instead of a trace")
+    tr.add_argument("--out", default=None,
+                    help="write the JSON here instead of stdout")
+    tr.add_argument("--timeout", type=float, default=10.0)
+    tr.set_defaults(fn=cmd_trace)
 
     i = sub.add_parser("info", help="inspect a checkpoint")
     i.add_argument("--model", required=True)

@@ -575,3 +575,31 @@ class FleetConfig:
     page_ship_max_bytes: int = 64 * 1024 * 1024
     # EMA smoothing for the measured-rate updates (0 disables learning).
     cost_ema_alpha: float = 0.2
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Distributed request tracing + engine flight recorder
+    (``utils/tracing.py``). A server constructed WITHOUT a TraceConfig
+    has tracing fully off: no recorder is attached, no TraceContext is
+    minted, frames carry no trace keys, and the engine's flight-recorder
+    slot stays ``None`` — the decode tick pays one attribute load.
+    """
+
+    # Master switch. With a TraceConfig present but ``enabled`` False the
+    # plumbing behaves exactly like the no-config case.
+    enabled: bool = True
+    # Fraction of requests minted a TraceContext at the gateway
+    # ([0, 1]). Unsampled requests take the ``ctx is None`` fast path
+    # everywhere — sampling is the production cost dial.
+    trace_sample_rate: float = 1.0
+    # Per-node SpanRecorder ring size. Eviction is counted
+    # (``trace_spans_dropped``) and surfaced in /healthz — never silent.
+    recorder_capacity: int = 100_000
+    # Flight-recorder ring size: per-engine-tick records kept for
+    # ``/debug/ticks``.
+    ticks_capacity: int = 512
+    # Per-node timeout for the ``trace.pull`` collector. A node that
+    # misses it is dropped from the stitched trace (partial trace, with
+    # ``trace_pull_failures`` counted) — collection never wedges.
+    collect_timeout_s: float = 2.0

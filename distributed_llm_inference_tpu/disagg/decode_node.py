@@ -52,6 +52,7 @@ from ..distributed.directory import DirectoryClient
 from ..distributed.messages import pack_frame, unpack_frame
 from ..distributed.relay import RelayClient
 from ..engine.sampling import SamplingOptions
+from ..utils.tracing import Span, SpanRecorder, TraceContext, trace_span
 from .kv_codec import (
     SchemaError, decode_pages, decode_session, encode_error, encode_pages,
     encode_session,
@@ -88,6 +89,13 @@ class _Route:
     # Marked by a fleet.migrate rebalance request: the driver hands this
     # stream back to its gateway at the next tick boundary.
     handoff: bool = False
+    # Distributed-trace context from the submit/resume frame's trace ids
+    # (None = unsampled request: every tracing hook below skips), plus the
+    # epoch time the route was admitted — the ``decode.first_token`` span
+    # closes against it once.
+    trace: Optional[object] = None
+    t0: float = 0.0
+    first_done: bool = False
 
 
 class DecodeNode:
@@ -116,6 +124,10 @@ class DecodeNode:
         )
         self.epoch = int(epoch)  # incarnation number (lease fencing)
         self.metrics = engine.metrics
+        # Per-node span log for distributed traces: decode admit/resume,
+        # first-token and drain-handoff spans land here and ``trace.pull``
+        # ships them back to the collecting gateway.
+        self.tracer = SpanRecorder(metrics=self.metrics)
         self._stop = threading.Event()
         self._ticks = 0
         # distcheck: unguarded-ok(one-way bool set by the consume thread on
@@ -196,6 +208,9 @@ class DecodeNode:
                 if op == "fleet.pages.put":
                     self._handle_pages_put(header, client)
                     continue  # distcheck: reply-ok(fleet.ack/nack sent by the handler)
+                if op == "trace.pull":
+                    self._handle_trace_pull(header)
+                    continue  # distcheck: reply-ok(trace.spans sent by _handle_trace_pull)
                 if op not in ("migrate.submit", "migrate.resume"):
                     self.metrics.counter("unknown_ops_dropped")
                     continue
@@ -217,47 +232,57 @@ class DecodeNode:
     def _handle_submit(self, header: dict, reply: str) -> None:
         gen = str(header.get("gen", ""))
         att = str(header.get("att", ""))
+        ctx = TraceContext.from_header(header)
         try:
             prompt = [int(t) for t in header["prompt"]]
             opts = SamplingOptions(**{
                 k: v for k, v in (header.get("options") or {}).items()
                 if k in _OPT_FIELDS
             })
-            gid = self.engine.submit(
-                prompt, opts, deadline=self._deadline_from(header)
-            )
+            with trace_span(self.tracer, "decode.admit", ctx,
+                            node=self.node_id, gen=gen):
+                gid = self.engine.submit(
+                    prompt, opts, deadline=self._deadline_from(header),
+                    trace=ctx,
+                )
         except Exception as e:
             logger.warning("submit %s failed on %s: %r", gen, self.node_id, e)
             self._send_err(reply, gen, att, repr(e))
             return  # distcheck: reply-ok(migrate.err reply sent via _send_err)
         with self._rlock:
             self._routes[gid] = _Route(gen=gen, reply=reply, att=att,
-                                       seq=0, seq0=0)
+                                       seq=0, seq0=0,
+                                       trace=ctx, t0=time.time())
             self._by_gen[gen] = gid
 
     def _handle_resume(self, header: dict, reply: str,
                        client: RelayClient) -> None:
         gen = str(header.get("gen", ""))
         att = str(header.get("att", ""))
+        ctx = TraceContext.from_header(header)
         try:
             kvq = header["kv"]
             nf = int(header["nf"])
             frm = int(header.get("from") or 0)
-            budget = time.monotonic() + self.dcfg.transfer_timeout_s
-            frames = []
-            for _ in range(nf):
-                frames.append(client.get(
-                    kvq, timeout=max(budget - time.monotonic(), 0.001)
-                ))
-            snap, _meta = decode_session(frames)
-            if snap is None:
-                raise ValueError("checkpoint transfer carried an error frame")
-            tail = [int(t) for t in snap["generated"]]
-            gid = self.engine.resume_session(
-                snap, deadline=self._deadline_from(header)
-            )
-            if gid is None:
-                raise RuntimeError("no decode slot free (pool pressure)")
+            with trace_span(self.tracer, "decode.resume", ctx,
+                            node=self.node_id, gen=gen):
+                budget = time.monotonic() + self.dcfg.transfer_timeout_s
+                frames = []
+                for _ in range(nf):
+                    frames.append(client.get(
+                        kvq, timeout=max(budget - time.monotonic(), 0.001)
+                    ))
+                snap, _meta = decode_session(frames)
+                if snap is None:
+                    raise ValueError(
+                        "checkpoint transfer carried an error frame"
+                    )
+                tail = [int(t) for t in snap["generated"]]
+                gid = self.engine.resume_session(
+                    snap, deadline=self._deadline_from(header), trace=ctx,
+                )
+                if gid is None:
+                    raise RuntimeError("no decode slot free (pool pressure)")
         except Exception as e:
             logger.warning("resume %s failed on %s: %r", gen, self.node_id, e)
             self._send_err(reply, gen, att, _err_code(e))
@@ -268,6 +293,7 @@ class DecodeNode:
             self._routes[gid] = _Route(
                 gen=gen, reply=reply, att=att, seq=g0, seq0=g0,
                 replay=replay, last_ckpt_tick=self._ticks,
+                trace=ctx, t0=time.time(),
             )
             self._by_gen[gen] = gid
 
@@ -277,6 +303,19 @@ class DecodeNode:
             gid = self._by_gen.get(gen)
         if gid is not None:
             self.engine.cancel(gid)
+
+    def _handle_trace_pull(self, header: dict) -> None:
+        """Answer a gateway's span collection for one trace with a single
+        ``trace.spans`` frame (spans ride the JSON header). Best-effort:
+        the gateway budgets the whole round and renders partial traces."""
+        reply, tid = header.get("reply"), header.get("trace")
+        if not reply or not tid:
+            return  # distcheck: reply-ok(frame carries no reply address)
+        spans = [s.to_dict() for s in self.tracer.spans_for(str(tid))]
+        self._send([(reply, pack_frame({
+            "op": "trace.spans", "trace": tid, "node": self.node_id,
+            "spans": spans,
+        }))])
 
     # -- fleet ops (drain / rebalance / page-ship) ----------------------------
 
@@ -289,6 +328,16 @@ class DecodeNode:
         self._draining = True
         with self._rlock:
             n = len(self._routes)
+        ctx = TraceContext.from_header(header)
+        if ctx is not None:
+            # Zero-duration marker under the controller's op-level trace:
+            # when drain mode flipped on and how many streams it covered.
+            c = ctx.child()
+            self.tracer.record(Span(
+                "fleet.drain", time.time(), 0.0, {"sessions": n},
+                trace_id=c.trace_id, span_id=c.span_id,
+                parent_id=c.parent_id, node=self.node_id,
+            ))
         reply = header.get("reply")
         if reply:
             self._send([(reply, pack_frame({
@@ -409,6 +458,17 @@ class DecodeNode:
                     reason = s.finish_reason if s is not None else None
                 frames: List[Tuple[str, bytes]] = []
                 if tok >= 0:
+                    if r.trace is not None and not r.first_done:
+                        # Admission → first generated token on this node:
+                        # the decode-side half of the request's TTFT.
+                        r.first_done = True
+                        c = r.trace.child()
+                        self.tracer.record(Span(
+                            "decode.first_token", r.t0, time.time() - r.t0,
+                            {"gen": r.gen, "seq": r.seq},
+                            trace_id=c.trace_id, span_id=c.span_id,
+                            parent_id=c.parent_id, node=self.node_id,
+                        ))
                     frames.append((r.reply, pack_frame({
                         "op": "migrate.tok", "gen": r.gen, "att": r.att,
                         "seq": r.seq, "tok": int(tok), "fin": bool(fin),
@@ -458,6 +518,8 @@ class DecodeNode:
         exports ``None`` and hands off cold — the gateway resubmits the
         prompt, still zero-loss because nothing was ever delivered."""
         self._flush_replay_route(r)
+        h0 = time.time()
+        child = r.trace.child() if r.trace is not None else None
         frames: List[Tuple[str, bytes]] = []
         snap = self.engine.export_session(gid)
         if snap is not None:
@@ -465,10 +527,12 @@ class DecodeNode:
                 r.gen, snap,
                 page_size=self.engine.ccfg.page_size,
                 max_frame_bytes=self.dcfg.kv_frame_bytes,
-                att=r.att,
+                att=r.att, trace=child,
             )]
         frames.append((r.reply, pack_frame({
             "op": "fleet.handoff", "gen": r.gen, "att": r.att,
+            "trace": child.trace_id if child is not None else None,
+            "span": child.span_id if child is not None else None,
         })))
         # Retire the route BEFORE cancelling: the cancel's finish event
         # must not chase the handoff down the reply queue as a bogus fin.
@@ -477,6 +541,15 @@ class DecodeNode:
             self._by_gen.pop(r.gen, None)
         if self._send(frames):
             self.metrics.counter("fleet_handoffs_sent")
+            if child is not None:
+                # Snapshot export + checkpoint/marker send: the node-side
+                # segment of a drain or rebalance re-home.
+                self.tracer.record(Span(
+                    "drain.handoff", h0, time.time() - h0,
+                    {"gen": r.gen, "frames": len(frames)},
+                    trace_id=child.trace_id, span_id=child.span_id,
+                    parent_id=child.parent_id, node=self.node_id,
+                ))
         # Either way the session leaves this engine: on send failure the
         # gateway's death detector re-homes from its last checkpoint.
         self.engine.cancel(gid)
@@ -521,7 +594,7 @@ class DecodeNode:
                 r.gen, snap,
                 page_size=self.engine.ccfg.page_size,
                 max_frame_bytes=self.dcfg.kv_frame_bytes,
-                att=r.att,
+                att=r.att, trace=r.trace,
             )
             if self._send([(r.reply, f) for f in frames]):
                 r.ckpted = True

@@ -123,6 +123,7 @@ def encode_kv(
     op: Optional[str] = None,
     session: Optional[dict] = None,
     att: Optional[str] = None,
+    trace=None,
 ) -> List[bytes]:
     """Serialize one session's KV planes into an ordered list of frames.
 
@@ -130,7 +131,10 @@ def encode_kv(
     for session checkpoints; ``None`` for plain prefill exports) and
     ``session`` carries the JSON-safe mid-decode state dict a checkpoint
     needs beyond KV — both ride every frame's header, like the rest of
-    the consistent metadata."""
+    the consistent metadata. ``trace`` (a
+    :class:`~..utils.tracing.TraceContext`, or None) stamps the standard
+    flat ``trace``/``span`` ids on every frame so the transfer is
+    attributable to its distributed trace."""
     payload = b"".join(_encode_plane(k, v) for k, v in planes.items())
     step = max(int(max_frame_bytes), 1)
     chunks = [payload[i : i + step] for i in range(0, len(payload), step)]
@@ -152,6 +156,10 @@ def encode_kv(
         "op": op,
         "session": session,
         "att": att,
+        # Distributed-trace attribution (not in _CONSISTENT: absent on
+        # pre-trace peers, and the ids never gate reassembly).
+        "trace": trace.trace_id if trace is not None else None,
+        "span": trace.span_id if trace is not None else None,
     }
     return [_pack(dict(header, i=i), c) for i, c in enumerate(chunks)]
 
@@ -254,6 +262,7 @@ def encode_session(
     op: str = "migrate.ckpt",
     att: Optional[str] = None,
     extra_chain: Sequence[bytes] = (),
+    trace=None,
 ) -> List[bytes]:
     """Serialize an ``engine.export_session`` snapshot into kv_codec
     frames: the KV planes ride the payload exactly like a prefill
@@ -276,6 +285,7 @@ def encode_session(
         op=op,
         session=sess,
         att=att,
+        trace=trace,
     )
 
 

@@ -12,8 +12,11 @@ Request frame (``messages.pack_frame`` JSON header, no array)::
 
     {"op": "prefill", "gen": <gateway id>, "reply": <reply queue>,
      "prompt": [int, ...], "options": {SamplingOptions fields},
-     "max_frame_bytes": int}
+     "max_frame_bytes": int, "trace": <id|None>, "span": <id|None>}
 
+The ``trace``/``span`` ids (None when the request is unsampled) attach
+this worker's ``prefill.export`` span to the request's distributed
+trace; the gateway collects it back with ``op: "trace.pull"``.
 ``op: "shutdown"`` stops the worker (tests). Anything malformed is
 dropped — a poisoned frame must not kill the pool member.
 """
@@ -28,9 +31,10 @@ from typing import Optional
 
 from ..config import DisaggConfig
 from ..distributed.directory import DirectoryClient
-from ..distributed.messages import unpack_frame
+from ..distributed.messages import pack_frame, unpack_frame
 from ..distributed.relay import RelayClient
 from ..engine.sampling import SamplingOptions
+from ..utils.tracing import SpanRecorder, TraceContext, trace_span
 from .kv_codec import encode_error, encode_kv
 
 __all__ = ["PrefillWorker"]
@@ -68,6 +72,9 @@ class PrefillWorker:
         self.lease_ttl = lease_ttl
         self.epoch = int(epoch)  # incarnation number (lease fencing)
         self.metrics = engine.metrics
+        # Per-node span log for distributed traces: prefill.export spans
+        # land here and ``trace.pull`` ships them back to the gateway.
+        self.tracer = SpanRecorder(metrics=self.metrics)
         self._stop = threading.Event()
         # Directory load hint: the consume thread counts in-flight prefills,
         # the heartbeat thread reports them — cross-thread, so locked
@@ -124,6 +131,9 @@ class PrefillWorker:
                     continue
                 if op == "shutdown":
                     return  # distcheck: reply-ok(shutdown frames are fire-and-forget)
+                if op == "trace.pull":
+                    self._handle_trace_pull(header)
+                    continue  # distcheck: reply-ok(trace.spans sent by _handle_trace_pull)
                 if op != "prefill":
                     self.metrics.counter("unknown_ops_dropped")
                     continue
@@ -140,21 +150,49 @@ class PrefillWorker:
         finally:
             client.close()
 
+    def _handle_trace_pull(self, header: dict) -> None:
+        """Answer a gateway's span collection for one trace with a single
+        ``trace.spans`` frame (spans ride the JSON header). Best-effort:
+        the gateway budgets the whole round and renders partial traces."""
+        reply, tid = header.get("reply"), header.get("trace")
+        if not reply or not tid:
+            return  # distcheck: reply-ok(frame carries no reply address)
+        spans = [s.to_dict() for s in self.tracer.spans_for(str(tid))]
+        try:
+            self._out.put(reply, pack_frame({
+                "op": "trace.spans", "trace": tid, "node": self.node_id,
+                "spans": spans,
+            }))
+        except (ConnectionError, OSError):
+            pass  # gateway's collect budget expires; partial trace renders
+
     def _handle(self, header: dict, reply: str) -> None:
         gen = str(header.get("gen", ""))
+        ctx = TraceContext.from_header(header)
         try:
             prompt = [int(t) for t in header["prompt"]]
             opts = _options_from(header.get("options"))
-            planes, first, chain = self.engine.prefill_export(prompt, opts)
-            frames = encode_kv(
-                gen, planes, len(prompt), first, chain,
-                page_size=self.engine.ccfg.page_size,
-                quant="ks" in planes or "cs" in planes,
-                max_frame_bytes=int(
-                    header.get("max_frame_bytes")
-                    or self.dcfg.kv_frame_bytes
-                ),
-            )
+            # The worker-side segment of the distributed trace: prompt
+            # prefill + first-token sample + frame encode, parented under
+            # the gateway's kv_transfer span; the encoded frames carry the
+            # same child ids so transfer and compute stitch together.
+            with trace_span(self.tracer, "prefill.export", ctx,
+                            node=self.node_id, gen=gen,
+                            prompt_tokens=len(header.get("prompt") or []),
+                            ) as child:
+                planes, first, chain = self.engine.prefill_export(
+                    prompt, opts
+                )
+                frames = encode_kv(
+                    gen, planes, len(prompt), first, chain,
+                    page_size=self.engine.ccfg.page_size,
+                    quant="ks" in planes or "cs" in planes,
+                    max_frame_bytes=int(
+                        header.get("max_frame_bytes")
+                        or self.dcfg.kv_frame_bytes
+                    ),
+                    trace=child,
+                )
             self.metrics.counter("disagg_kv_frames_sent", len(frames))
         except Exception as e:  # answer with an error, never wedge the peer
             logger.warning(

@@ -46,7 +46,7 @@ _SINK_KINDS = (SinkKVCache, QuantizedSinkKVCache)
 from ..config import CacheConfig, EngineConfig, ModelConfig, PrefixConfig
 from ..models import llama
 from ..utils.metrics import Metrics
-from ..utils.tracing import SpanRecorder, span
+from ..utils.tracing import FlightRecorder, SpanRecorder, span
 from .plan import AttentionPlan
 from .sampling import SamplingOptions, SamplingParams, sample
 from .session import Session, SessionState
@@ -71,6 +71,7 @@ class InferenceEngine:
         mesh_cfg=None,
         draft=None,
         prefix_cfg=None,
+        trace_cfg=None,
     ):
         """``mesh_cfg`` (a :class:`MeshConfig`) serves one sharded deployment
         of the model: tp/ep shard within a replica, dp shards batch rows, and
@@ -138,6 +139,15 @@ class InferenceEngine:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics = Metrics()
         self.spans = SpanRecorder()
+        # Flight recorder (``trace_cfg`` = a config.TraceConfig): a bounded
+        # ring of per-tick records behind /debug/ticks. None when tracing
+        # is off — step() then pays one attribute load + branch, no
+        # allocation, no host sync (the DC301 decode-tick contract).
+        self.flight = (
+            FlightRecorder(trace_cfg.ticks_capacity)
+            if trace_cfg is not None and trace_cfg.enabled
+            else None
+        )
         # Scheduler lock (SURVEY §5.2): slots/cache/allocator are mutated
         # only by step()/collect_finished() under this lock (single-writer).
         # submit()/cancel() are deliberately LOCK-FREE — step() holds the
@@ -1160,6 +1170,7 @@ class InferenceEngine:
         options: Optional[SamplingOptions] = None,
         deadline: Optional[float] = None,
         sched_key: Optional[tuple] = None,
+        trace=None,
     ) -> str:
         """Queue a prompt; returns its generation_id. Thread-safe.
 
@@ -1169,13 +1180,17 @@ class InferenceEngine:
 
         ``sched_key`` is the gateway scheduler's admission-ordering stamp
         (see :meth:`set_admission_order`); sessions without one are
-        admitted FIFO."""
+        admitted FIFO.
+
+        ``trace`` is the request's distributed TraceContext (None for
+        unsampled requests); it rides the Session for span attribution
+        and never affects scheduling or tokens."""
         return self._submit_session(
-            prompt, options, deadline, sched_key=sched_key
+            prompt, options, deadline, sched_key=sched_key, trace=trace
         ).generation_id
 
     def _submit_session(self, prompt, options, deadline=None,
-                        sched_key=None) -> Session:
+                        sched_key=None, trace=None) -> Session:
         # Lock-free on purpose: step() holds the scheduler lock across whole
         # device steps (hundreds of ms at 7B shapes), and request-handler
         # threads must not stall on it. deque.append and dict insertion are
@@ -1188,6 +1203,7 @@ class InferenceEngine:
             options=options or SamplingOptions(),
             deadline=deadline,
             sched_key=sched_key,
+            trace=trace,
         )
         self.sessions[s.generation_id] = s
         self.waiting.append(s)
@@ -1227,6 +1243,12 @@ class InferenceEngine:
         next device tick BEFORE resolving the previous one, so a tick's
         tokens arrive one ``step()`` later than they were dispatched."""
         produced: List[Tuple[str, int, bool]] = []
+        # Flight recorder: host-clock only (perf_counter — no device_get,
+        # no block_until_ready), and None unless a TraceConfig enabled it,
+        # so the disabled tick pays one attribute load + branch.
+        fr = self.flight
+        t0 = time.perf_counter() if fr is not None else 0.0
+        queued0 = len(self.waiting) if fr is not None else 0
         with self._lock:
             if self._ext_produced:
                 produced.extend(self._ext_produced)
@@ -1259,6 +1281,23 @@ class InferenceEngine:
                     # otherwise has_work() reports the orphaned pending
                     # tick forever.
                     self._spec_flush(produced)
+        if fr is not None:
+            queued1 = len(self.waiting)
+            fr.record(
+                kind="pipelined" if self._pipelined else "plain",
+                occupancy=sum(1 for g in self.slots if g is not None),
+                queued=queued1,
+                admitted=max(0, queued0 - queued1),
+                chunking=len(self._chunking),
+                parked=sum(
+                    1 for s in self._chunking if s.parked_key is not None
+                ),
+                overlap_inflight=len(self._inflight_admits),
+                pending=self._pending is not None,
+                events=len(produced),
+                dispatch=self.plan.last_dispatch,
+                host_ms=(time.perf_counter() - t0) * 1e3,
+            )
         return produced
 
     def has_work(self) -> bool:
@@ -1670,6 +1709,7 @@ class InferenceEngine:
         first_token: int,
         options: Optional[SamplingOptions] = None,
         deadline: Optional[float] = None,
+        trace=None,
     ) -> Optional[str]:
         """Admit a session whose prompt KV was prefilled REMOTELY: allocate
         a row (and pages), ingest the shipped planes into a batch-1 view,
@@ -1709,6 +1749,7 @@ class InferenceEngine:
                 prompt=prompt,
                 options=options or SamplingOptions(),
                 deadline=deadline,
+                trace=trace,
             )
             s.disagg = True
             if not self._capacity_ok(s):
@@ -1854,6 +1895,7 @@ class InferenceEngine:
         self,
         snapshot,
         deadline: Optional[float] = None,
+        trace=None,
     ) -> Optional[str]:
         """Re-admit a session exported by :meth:`export_session` and keep
         decoding from its exact position: ingest KV for
@@ -1926,6 +1968,7 @@ class InferenceEngine:
                 options=options,
                 deadline=deadline,
                 generated=generated,
+                trace=trace,
             )
             s.disagg = True
             s.resumes = int(snapshot.get("resumes", 0)) + 1

@@ -110,6 +110,10 @@ class AttentionPlan:
             )
         self._credit = 0.0
         self._shapes = set()
+        # Last dispatch seen by note_dispatch, as (kind, shape, valid) —
+        # read by the engine's flight recorder so each tick record carries
+        # the dispatch shape without a second telemetry funnel.
+        self.last_dispatch: Optional[Tuple] = None
         # Set by the engine when the cache stores the latent (MLA) fused
         # form: every dispatch then reads latents and decompresses in
         # place via the page walk, which note_dispatch surfaces as the
@@ -230,6 +234,9 @@ class AttentionPlan:
         under ragged mode count ``attn_ragged_dispatches`` and publish the
         valid/padded occupancy gauge."""
         key = (kind,) + tuple(int(x) for x in shape)
+        self.last_dispatch = (
+            kind, tuple(int(x) for x in shape), valid_tokens
+        )
         if key not in self._shapes:
             self._shapes.add(key)
             if self.metrics is not None:

@@ -92,6 +92,11 @@ class Session:
     # engine's admission-order hook. None = direct engine user, admitted
     # in FIFO order ahead of scheduled sessions.
     sched_key: Optional[tuple] = None
+    # Distributed-trace context (utils.tracing.TraceContext) minted at the
+    # gateway and threaded through Handle/ticket plumbing; None for
+    # unsampled requests and direct engine users — every tracing hook
+    # short-circuits on that None, keeping the disabled path free.
+    trace: Optional[Any] = None
     # timing (metrics: TTFT, tokens/sec — SURVEY §5.5)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

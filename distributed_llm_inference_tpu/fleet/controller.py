@@ -42,6 +42,7 @@ from ..distributed.directory import DirectoryClient
 from ..distributed.messages import pack_frame, unpack_frame
 from ..distributed.relay import RelayClient
 from ..utils.metrics import Metrics
+from ..utils.tracing import TraceContext
 from .policy import by_node_id, hot_rows, least_loaded, live_decode_rows, mean_load
 
 log = logging.getLogger(__name__)
@@ -92,8 +93,13 @@ class FleetController:
             raise LookupError(f"node {node_id!r} not alive in the directory")
         epoch = row.get("epoch")
         self.metrics.counter("fleet_drains")
+        # Op-level trace: always sampled — drains are rare control-plane
+        # events, and the node's fleet.drain span marks when the command
+        # landed relative to the per-request handoff spans it triggers.
+        ctx = TraceContext.mint(1.0)
         self._client.put(row["queue"], pack_frame(
-            {"op": "fleet.drain", "reply": self._reply}))
+            {"op": "fleet.drain", "reply": self._reply,
+             "trace": ctx.trace_id, "span": ctx.span_id}))
         ack = self._await_ack("drain", timeout=2.0)
         sessions = int(ack.get("n", 0)) if ack else -1
         budget = self.fcfg.drain_timeout_s if timeout is None else timeout
@@ -116,7 +122,7 @@ class FleetController:
         log.info("fleet: drained %s (sessions=%d drained=%s floor=%d)",
                  node_id, sessions, drained, floor)
         return {"node_id": node_id, "sessions": sessions,
-                "drained": drained, "floor": floor}
+                "drained": drained, "floor": floor, "trace": ctx.trace_id}
 
     # --- rebalance -------------------------------------------------------
 
@@ -132,8 +138,10 @@ class FleetController:
                        int(row.get("load", 0)))
             if want <= 0:
                 continue
+            ctx = TraceContext.mint(1.0)
             self._client.put(row["queue"], pack_frame(
-                {"op": "fleet.migrate", "n": want, "reply": self._reply}))
+                {"op": "fleet.migrate", "n": want, "reply": self._reply,
+                 "trace": ctx.trace_id, "span": ctx.span_id}))
             ack = self._await_ack("migrate", timeout=2.0)
             got = int(ack.get("n", 0)) if ack else 0
             if got > 0:

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..config import SchedConfig
 from ..utils.metrics import Metrics
+from ..utils.tracing import Span
 from .estimator import LatencyEstimator
 from .tenant import TokenBucket, resolve_tenant
 
@@ -51,6 +52,11 @@ class Ticket:
     backlog_tokens: float  # pending token cost ahead at admission
     started: bool = False  # first token observed
     closed: bool = False
+    # Distributed-trace context for this request (None when unsampled):
+    # note_first_token records the queue-wait span against it — the SAME
+    # measurement that feeds the shed estimator, so the trace's queue
+    # segment and the shedder can never disagree.
+    trace: Optional[object] = None
 
     @property
     def sort_key(self) -> Tuple[int, float, int]:
@@ -93,6 +99,10 @@ class Scheduler:
         self._depth = {lane: 0 for lane in _LANES}
         self._pending_tokens = {lane: 0.0 for lane in _LANES}
         self._est = LatencyEstimator(alpha=self.cfg.ema_alpha)
+        # Distributed-trace recorder (set by the gateway when tracing is
+        # on): note_first_token records each sampled request's queue-wait
+        # span here, from the same ttft observation the estimator eats.
+        self.tracer = None
         with self._lock:
             self._publish_depths()
 
@@ -241,10 +251,21 @@ class Scheduler:
         with self._lock:
             self._retire_locked(t)
             self._est.observe(ttft_s, t.prompt_tokens, t.backlog_tokens)
-            self.metrics.observe(
-                "sched_queue_wait",
-                self._est.queue_wait(ttft_s, t.prompt_tokens),
-            )
+            wait = self._est.queue_wait(ttft_s, t.prompt_tokens)
+            self.metrics.observe("sched_queue_wait", wait)
+        rec, ctx = self.tracer, t.trace
+        if rec is not None and ctx is not None:
+            # The queue-wait segment of the distributed trace, on the
+            # epoch clock: it ends at first token (now) and covers the
+            # estimator's queue-wait share of the measured TTFT.
+            child = ctx.child()
+            rec.record(Span(
+                "sched.queue_wait", time.time() - ttft_s, wait,
+                {"tenant": t.tenant, "lane": t.lane,
+                 "ttft_s": ttft_s, "backlog_tokens": t.backlog_tokens},
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=child.parent_id, node="gateway",
+            ))
 
     def note_finished(self, t: Ticket) -> None:
         """Terminal event for the request (stream closed, cancelled,

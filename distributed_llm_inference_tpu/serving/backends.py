@@ -18,9 +18,11 @@ One protocol, two implementations:
   tokens by sequence index so the client sees each token exactly once.
 
 Both expose the same surface the server consumes: ``start(loop)``,
-``submit(prompt, options, deadline, ticket=None) -> Handle``,
+``submit(prompt, options, deadline, ticket=None, trace=None) -> Handle``,
 ``cancel(handle)``, ``active_sessions()``, ``queue_depth()``,
-``stop()``, ``.metrics``, ``attach_scheduler(sched)``.
+``stop()``, ``.metrics``, ``attach_scheduler(sched)``,
+``attach_tracer(recorder, cfg)``, ``collect_trace(trace_id)``,
+``flight_snapshot()``.
 
 Admission policy lives OUTSIDE the backends, in :mod:`..sched`: the
 gateway's :class:`~..sched.Scheduler` decides rate limits, lanes and
@@ -48,6 +50,7 @@ from ..fleet.costmodel import CostModel
 from ..fleet.policy import least_loaded, live_decode_rows
 from ..sched.placement import choose_decode_node, prefix_worth_detour
 from ..utils.metrics import Metrics
+from ..utils.tracing import Span, trace_span
 
 logger = logging.getLogger("distributed_llm_inference_tpu")
 
@@ -79,12 +82,25 @@ class Handle:
     # the gateway hands it back to the scheduler at first token / finish
     # for lane-depth and estimator accounting. None = scheduler off.
     ticket: Optional[object] = None
+    # Distributed-trace context minted at the gateway
+    # (utils.tracing.TraceContext); None = unsampled — every tracing hook
+    # along the request path short-circuits on that None.
+    trace: Optional[object] = None
+    # Epoch time the session entered decode (engine submit / prefilled
+    # admit). The fan-out closes the ``gateway.decode_wait`` span from it
+    # at the stream's first event, then clears it.
+    t_decode0: Optional[float] = None
 
 
 class Backend:
     """Interface contract (duck-typed; this base just documents it)."""
 
     metrics: Metrics
+    # Distributed-trace recorder + TraceConfig (attach_tracer). Class-level
+    # None keeps every per-request tracing hook one attribute test when the
+    # gateway runs without tracing.
+    tracer = None
+    tcfg = None
 
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         raise NotImplementedError
@@ -95,6 +111,7 @@ class Backend:
         options: SamplingOptions,
         deadline: Optional[float],
         ticket=None,
+        trace=None,
     ) -> Handle:
         raise NotImplementedError
 
@@ -103,6 +120,91 @@ class Backend:
         local engine wire its admission-order hook; the rest carry
         tickets for accounting only (their admission queue lives
         downstream, already gated by the scheduler at the gateway)."""
+
+    def attach_tracer(self, recorder, cfg) -> None:
+        """Install the gateway's span recorder + TraceConfig. Backends
+        record their gateway-side child spans into it; remote spans are
+        gathered per trace by :meth:`collect_trace`."""
+        self.tracer = recorder
+        self.tcfg = cfg
+
+    def flight_snapshot(self, last: Optional[int] = None) -> List[dict]:
+        """Per-tick engine flight-recorder records for ``/debug/ticks``.
+        Backends without a local engine have none."""
+        return []
+
+    def _trace_targets(self) -> List[dict]:
+        """Directory rows of the remote nodes that may hold spans for this
+        gateway's requests — the ``trace.pull`` fan-out set."""
+        return []
+
+    def collect_trace(self, trace_id: str) -> Dict[str, List[dict]]:
+        """Gather one distributed trace: local (gateway) spans plus a
+        ``trace.pull`` round to every remote node this backend routes to.
+        Best-effort by design — a node that died or times out just leaves
+        its lane out of the stitched trace (``trace_pull_failures``
+        counts it); collection must never wedge behind a dead node."""
+        out: Dict[str, List[dict]] = {}
+        if self.tracer is not None:
+            local = self.tracer.spans_for(trace_id)
+            if local:
+                out["gateway"] = [s.to_dict() for s in local]
+        rows = self._trace_targets()
+        if rows:
+            self._pull_remote_spans(trace_id, rows, out)
+        return out
+
+    def _pull_remote_spans(
+        self, trace_id: str, rows: List[dict], out: Dict[str, List[dict]]
+    ) -> None:
+        from ..distributed.messages import pack_frame, unpack_frame
+        from ..distributed.relay import RelayClient
+
+        port = getattr(self, "relay_port", None)
+        if port is None:
+            return
+        timeout = (
+            self.tcfg.collect_timeout_s if self.tcfg is not None else 2.0
+        )
+        reply = f"trace.spans.{uuid.uuid4().hex[:12]}"
+        client = RelayClient(getattr(self, "relay_host", "127.0.0.1"), port)
+        try:
+            sent = 0
+            for row in rows:
+                try:
+                    client.put(row["queue"], pack_frame({
+                        "op": "trace.pull", "trace": trace_id,
+                        "reply": reply,
+                    }))
+                    sent += 1
+                except Exception:  # noqa: BLE001 - node gone: partial trace
+                    self.metrics.counter("trace_pull_failures")
+            budget = time.monotonic() + timeout
+            got = 0
+            while got < sent:
+                try:
+                    frame = client.get(
+                        reply, timeout=max(budget - time.monotonic(), 0.001)
+                    )
+                except Exception:  # noqa: BLE001 - timeout or relay lost
+                    # ONE shared budget for the whole round, not per node:
+                    # a dead node costs at most collect_timeout_s total.
+                    self.metrics.counter("trace_pull_failures", sent - got)
+                    break
+                try:
+                    header, _ = unpack_frame(frame)
+                except Exception:  # noqa: BLE001
+                    self.metrics.counter("malformed_frames")
+                    continue
+                if (header.get("op") != "trace.spans"
+                        or header.get("trace") != trace_id):
+                    self.metrics.counter("unknown_ops_dropped")
+                    continue
+                got += 1
+                node = str(header.get("node") or f"node-{got}")
+                out.setdefault(node, []).extend(header.get("spans") or [])
+        finally:
+            client.close()
 
     def cancel(self, handle: Handle) -> None:
         raise NotImplementedError
@@ -176,6 +278,20 @@ class EngineBackend(Backend):
                     h = self._handles.get(gid)
                 if h is None:
                     continue  # caller already gone (disconnect races a tick)
+                if h.trace is not None and h.t_decode0 is not None:
+                    # First event since the session entered decode: close
+                    # the gateway-side decode-wait segment (epoch clock so
+                    # it stitches against remote lanes).
+                    rec = self.tracer
+                    if rec is not None:
+                        c = h.trace.child()
+                        rec.record(Span(
+                            "gateway.decode_wait", h.t_decode0,
+                            time.time() - h.t_decode0, {"gen_id": gid},
+                            trace_id=c.trace_id, span_id=c.span_id,
+                            parent_id=c.parent_id, node="gateway",
+                        ))
+                    h.t_decode0 = None
                 reason = None
                 if finished:
                     s = self.engine.sessions.get(gid)
@@ -201,15 +317,23 @@ class EngineBackend(Backend):
                 except RuntimeError:
                     pass  # loop already closed (server exited mid-tick)
 
-    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None,
+               trace=None) -> Handle:
         with self._hlock:
             gid = self.engine.submit(
                 prompt, options, deadline=deadline,
                 sched_key=ticket.sort_key if ticket is not None else None,
+                trace=trace,
             )
-            h = Handle(gen_id=gid, queue=asyncio.Queue(), ticket=ticket)
+            h = Handle(gen_id=gid, queue=asyncio.Queue(), ticket=ticket,
+                       trace=trace,
+                       t_decode0=time.time() if trace is not None else None)
             self._handles[gid] = h
         return h
+
+    def flight_snapshot(self, last: Optional[int] = None) -> List[dict]:
+        fr = getattr(self.engine, "flight", None)
+        return fr.snapshot(last) if fr is not None else []
 
     def attach_scheduler(self, sched) -> None:
         # The engine's admission hook consumes the scheduler's ordering
@@ -287,14 +411,15 @@ class DisaggBackend(EngineBackend):
         self._tlock = threading.Lock()
         self._transfers: Dict[str, threading.Thread] = {}
 
-    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None,
+               trace=None) -> Handle:
         # The engine gen_id doesn't exist until the KV lands; hand the
         # server a provisional handle and rebind it at admission. ``stop``
         # doubles as the cancel signal for the transfer window, when the
         # engine doesn't know the session yet.
         key = f"disagg-{uuid.uuid4().hex[:12]}"
         h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event(),
-                   ticket=ticket)
+                   ticket=ticket, trace=trace)
         t = threading.Thread(
             target=self._run_disagg,
             args=(h, key, list(prompt), options, deadline),
@@ -360,7 +485,18 @@ class DisaggBackend(EngineBackend):
             return None
         return min(nodes, key=lambda n: n.get("load", 0))
 
-    def _fetch_kv(self, node, prompt, options, deadline, stop):
+    def _trace_targets(self) -> List[dict]:
+        from ..distributed.directory import DirectoryClient
+
+        try:
+            with DirectoryClient(self.relay_port, self.relay_host) as d:
+                return [
+                    n for n in d.alive() if n.get("role") == "prefill"
+                ]
+        except Exception:  # noqa: BLE001 - directory blip: partial trace
+            return []
+
+    def _fetch_kv(self, node, prompt, options, deadline, stop, trace=None):
         """Ship ``prompt`` to ``node``; return the decoded ``(planes,
         meta)``. Raises on any transport or integrity failure (the caller
         falls back), :class:`_TransferAborted` on cancel/stop."""
@@ -386,6 +522,10 @@ class DisaggBackend(EngineBackend):
                 "prompt": prompt,
                 "options": dataclasses.asdict(options),
                 "max_frame_bytes": self.dcfg.kv_frame_bytes,
+                # Distributed-trace propagation: the worker parents its
+                # prefill.export span under this kv_transfer segment.
+                "trace": trace.trace_id if trace is not None else None,
+                "span": trace.span_id if trace is not None else None,
             }))
             while total is None or len(frames) < total:
                 now = time.monotonic()
@@ -430,6 +570,7 @@ class DisaggBackend(EngineBackend):
         t0 = time.monotonic()
         gid: Optional[str] = None
         fail: Optional[str] = None
+        tctx, rec = h.trace, self.tracer
         try:
             try:
                 if self._prefer_local(prompt):
@@ -446,35 +587,45 @@ class DisaggBackend(EngineBackend):
                                 h.ticket.sort_key
                                 if h.ticket is not None else None
                             ),
+                            trace=tctx,
                         )
                         h.gen_id = gid
+                        if tctx is not None:
+                            h.t_decode0 = time.time()
                         self._handles[gid] = h
                     if h.stop.is_set():
                         self.engine.cancel(gid)
                     return
-                node = self._pick_prefill_node()
-                # Optional grace for an empty pool (rolling restart of the
-                # prefill tier): poll until a node appears or the grace
-                # lapses, then fall back rather than queue indefinitely.
-                wait_until = t0 + self.dcfg.prefill_wait_s
-                while (node is None and time.monotonic() < wait_until
-                       and not h.stop.is_set()
-                       and not self._stop_evt.is_set()):
-                    time.sleep(0.1)
+                with trace_span(rec, "gateway.route", tctx, node="gateway"):
                     node = self._pick_prefill_node()
-                if node is None:
-                    raise LookupError("no prefill node registered")
-                planes, meta = self._fetch_kv(
-                    node, prompt, options, deadline, h.stop
-                )
-                with self._hlock:
-                    gid = self.engine.admit_prefilled(
-                        prompt, planes, meta["first_token"],
-                        options=options, deadline=deadline,
+                    # Optional grace for an empty pool (rolling restart of
+                    # the prefill tier): poll until a node appears or the
+                    # grace lapses, then fall back rather than queue
+                    # indefinitely.
+                    wait_until = t0 + self.dcfg.prefill_wait_s
+                    while (node is None and time.monotonic() < wait_until
+                           and not h.stop.is_set()
+                           and not self._stop_evt.is_set()):
+                        time.sleep(0.1)
+                        node = self._pick_prefill_node()
+                    if node is None:
+                        raise LookupError("no prefill node registered")
+                with trace_span(rec, "gateway.kv_transfer", tctx,
+                                node="gateway") as kctx:
+                    planes, meta = self._fetch_kv(
+                        node, prompt, options, deadline, h.stop, trace=kctx
                     )
-                    if gid is not None:
-                        h.gen_id = gid
-                        self._handles[gid] = h
+                with trace_span(rec, "gateway.admit", tctx, node="gateway"):
+                    with self._hlock:
+                        gid = self.engine.admit_prefilled(
+                            prompt, planes, meta["first_token"],
+                            options=options, deadline=deadline, trace=tctx,
+                        )
+                        if gid is not None:
+                            h.gen_id = gid
+                            if tctx is not None:
+                                h.t_decode0 = time.time()
+                            self._handles[gid] = h
                 if gid is None:
                     raise RuntimeError("decode pool at capacity")
                 # Prefill-side TTFT: request arrival → KV imported with the
@@ -500,8 +651,11 @@ class DisaggBackend(EngineBackend):
                                     h.ticket.sort_key
                                     if h.ticket is not None else None
                                 ),
+                                trace=tctx,
                             )
                             h.gen_id = gid
+                            if tctx is not None:
+                                h.t_decode0 = time.time()
                             self._handles[gid] = h
                     except Exception as e2:  # noqa: BLE001
                         fail = f"error: {type(e2).__name__}"
@@ -570,7 +724,8 @@ class ClientBackend(Backend):
             )
             self._collector.start()
 
-    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None,
+               trace=None) -> Handle:
         if self._stop_evt.is_set():
             # The server drains before backend.stop(), so this only fires
             # on a race — but a request enqueued after stop would never get
@@ -579,8 +734,10 @@ class ClientBackend(Backend):
         with self._tlock:
             self._ids += 1
             gid = f"req-{self._ids}"
+        # Carried for the X-Trace-Id echo only: the relay tier predates the
+        # trace header protocol, so no remote spans exist to stitch.
         h = Handle(gen_id=gid, queue=asyncio.Queue(), stop=threading.Event(),
-                   ticket=ticket)
+                   ticket=ticket, trace=trace)
         if self._pending is not None:
             # Not added to _active yet: a queued request is counted by
             # queue_depth() alone until the collector claims it (admission
@@ -873,12 +1030,13 @@ class FleetBackend(Backend):
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
 
-    def submit(self, prompt, options, deadline, ticket=None) -> Handle:
+    def submit(self, prompt, options, deadline, ticket=None,
+               trace=None) -> Handle:
         if self._stop_evt.is_set():
             raise RuntimeError("backend is stopping")
         key = f"fleet-{uuid.uuid4().hex[:12]}"
         h = Handle(gen_id=key, queue=asyncio.Queue(), stop=threading.Event(),
-                   ticket=ticket)
+                   ticket=ticket, trace=trace)
         t = threading.Thread(
             target=self._run_fleet,
             args=(h, key, list(prompt), options, deadline),
@@ -919,6 +1077,17 @@ class FleetBackend(Backend):
             threads = list(self._threads.values())
         for t in threads:
             t.join(timeout=max(0.0, end - time.monotonic()))
+
+    def _trace_targets(self) -> List[dict]:
+        from ..distributed.directory import DirectoryClient
+
+        try:
+            with DirectoryClient(self.relay_port, self.relay_host) as d:
+                return [
+                    n for n in d.alive() if n.get("role") == "decode"
+                ]
+        except Exception:  # noqa: BLE001 - directory blip: partial trace
+            return []
 
     # -- per-request stream loop -------------------------------------------
 
@@ -1077,6 +1246,7 @@ class FleetBackend(Backend):
         fail: Optional[str] = None
         finished = False
         cancel_sent: Optional[float] = None
+        tctx, rec = h.trace, self.tracer
         # Fresh relay/directory clients per request: neither is
         # thread-safe, and request threads must not serialize on a socket.
         client = RelayClient(self.relay_host, self.relay_port)
@@ -1107,7 +1277,12 @@ class FleetBackend(Backend):
 
         def dispatch(n: dict) -> None:
             """Send this attempt to node ``n``: checkpoint replay when we
-            have one, cold prompt resubmission otherwise."""
+            have one, cold prompt resubmission otherwise. Either frame
+            carries the trace ids so the node's decode spans parent under
+            this request's trace (None keys when unsampled)."""
+            child = tctx.child() if tctx is not None else None
+            tid = child.trace_id if child is not None else None
+            sid = child.span_id if child is not None else None
             if ckpt:
                 kvq = f"fleet.kv.{uuid.uuid4().hex[:12]}"
                 client.put_many((kvq, f) for f in ckpt)
@@ -1115,6 +1290,7 @@ class FleetBackend(Backend):
                     "op": "migrate.resume", "gen": key, "reply": reply,
                     "att": att, "kv": kvq, "nf": len(ckpt),
                     "from": delivered, "deadline_s": remaining_s(),
+                    "trace": tid, "span": sid,
                 }))
             else:
                 client.put(n["queue"], pack_frame({
@@ -1122,6 +1298,7 @@ class FleetBackend(Backend):
                     "att": att, "prompt": prompt,
                     "options": dataclasses.asdict(options),
                     "deadline_s": remaining_s(),
+                    "trace": tid, "span": sid,
                 }))
 
         def pick(wait_s: float) -> Optional[dict]:
@@ -1162,6 +1339,7 @@ class FleetBackend(Backend):
             """Re-home the stream. Returns False with ``fail`` set when
             the request is out of road (budget, deadline, empty pool)."""
             nonlocal node, att, attempt, t_detect, partial, fail
+            r0 = time.time()
             enter_recovery()
             if t_detect is None:
                 t_detect = time.monotonic()
@@ -1206,6 +1384,17 @@ class FleetBackend(Backend):
                 self.metrics.counter("resume_failures")
                 fail = "error: relay lost"
                 return False
+            if rec is not None and tctx is not None:
+                # The re-home segment: death/handoff detection through the
+                # replacement dispatch, on the gateway's trace lane.
+                c = tctx.child()
+                rec.record(Span(
+                    "gateway.rehome", r0, time.time() - r0,
+                    {"attempt": attempt, "fenced": fence,
+                     "node": node.get("node_id")},
+                    trace_id=c.trace_id, span_id=c.span_id,
+                    parent_id=c.parent_id, node="gateway",
+                ))
             return True
 
         try:
@@ -1305,6 +1494,19 @@ class FleetBackend(Backend):
                     # cannot bounce the stream straight back before the
                     # draining heartbeat lands in the directory.
                     self.metrics.counter("fleet_drained_sessions")
+                    if rec is not None and tctx is not None:
+                        # The marker carries the node-side handoff span ids:
+                        # record the link so a stitched trace joins this
+                        # re-home to the node's drain.handoff span even if
+                        # a later trace.pull races the node's shutdown.
+                        c = tctx.child()
+                        rec.record(Span(
+                            "gateway.handoff_marker", time.time(), 0.0,
+                            {"node_trace": header.get("trace"),
+                             "node_span": header.get("span")},
+                            trace_id=c.trace_id, span_id=c.span_id,
+                            parent_id=c.parent_id, node="gateway",
+                        ))
                     if node is not None:
                         dead_ids.add(node.get("node_id"))
                     if not recover(False):

@@ -8,7 +8,10 @@ no chunked encoding needed). Routes:
   (``stream: true``).
 * ``GET /metrics`` — Prometheus text (engine + gateway counters, plus
   point-in-time queue/session gauges).
-* ``GET /healthz`` — liveness + drain state.
+* ``GET /healthz`` — liveness + drain state (+ trace recorder depth).
+* ``GET /debug/trace/<id>`` — one request's stitched cross-node trace
+  (Chrome trace-event JSON; spans pulled from remote nodes on demand).
+* ``GET /debug/ticks`` — the engine flight recorder's per-tick ring.
 
 Admission control: at ``ServingConfig.max_queue_depth`` gateway-in-flight
 completions, new ones get 429 + ``Retry-After`` (backpressure a load
@@ -29,8 +32,14 @@ import time
 import uuid
 from typing import Optional
 
-from ..config import SchedConfig, ServingConfig
+from ..config import SchedConfig, ServingConfig, TraceConfig
 from ..sched import Scheduler
+from ..utils.tracing import (
+    Span,
+    SpanRecorder,
+    TraceContext,
+    stitch_chrome_trace,
+)
 from .backends import Backend, Handle, TokenEvent
 from .breaker import CircuitBreaker
 from .protocol import (
@@ -57,6 +66,13 @@ def _retry_after_line(seconds: float) -> str:
     return f"Retry-After: {max(seconds, 0.001):.3f}\r\n"
 
 
+def _trace_id_line(handle: Handle) -> str:
+    """``X-Trace-Id`` header line for a sampled request ("" otherwise) —
+    the id a client quotes to ``/debug/trace/<id>``."""
+    t = getattr(handle, "trace", None)
+    return f"X-Trace-Id: {t.trace_id}\r\n" if t is not None else ""
+
+
 def _response(status: str, body: bytes, content_type: str = "application/json",
               extra: str = "") -> bytes:
     return (
@@ -78,7 +94,8 @@ class ApiServer:
     """
 
     def __init__(self, backend: Backend, scfg: Optional[ServingConfig] = None,
-                 tokenizer=None, sched_cfg: Optional[SchedConfig] = None):
+                 tokenizer=None, sched_cfg: Optional[SchedConfig] = None,
+                 trace_cfg: Optional[TraceConfig] = None):
         self.backend = backend
         self.scfg = scfg or ServingConfig()
         self.tokenizer = tokenizer
@@ -89,6 +106,20 @@ class ApiServer:
         if sched_cfg is not None:
             self.sched = Scheduler(sched_cfg, backend.metrics)
             backend.attach_scheduler(self.sched)
+        # Distributed request tracing (utils/tracing.py): mint a
+        # TraceContext per sampled request, record gateway-side spans into
+        # one recorder shared with the backend and scheduler, and serve
+        # /debug/trace/<id> as a stitched cross-node Chrome trace. None =
+        # tracing off; every per-request hook then short-circuits.
+        self.tcfg = trace_cfg
+        self.tracer: Optional[SpanRecorder] = None
+        if trace_cfg is not None and trace_cfg.enabled:
+            self.tracer = SpanRecorder(
+                trace_cfg.recorder_capacity, metrics=backend.metrics
+            )
+            backend.attach_tracer(self.tracer, trace_cfg)
+            if self.sched is not None:
+                self.sched.tracer = self.tracer
         # The breaker shares the backend's Metrics, so its state gauge and
         # transition counters ride the same /metrics endpoint.
         self.breaker = CircuitBreaker(
@@ -248,6 +279,10 @@ class ApiServer:
             await self._healthz(writer)
         elif method == "GET" and path == "/metrics":
             await self._metrics(writer)
+        elif method == "GET" and path.startswith("/debug/trace/"):
+            await self._debug_trace(writer, path[len("/debug/trace/"):])
+        elif method == "GET" and path == "/debug/ticks":
+            await self._debug_ticks(writer)
         elif method == "POST" and path == "/v1/completions":
             await self._completions(writer, body, headers)
         elif path in ("/healthz", "/metrics", "/v1/completions"):
@@ -275,7 +310,42 @@ class ApiServer:
             # Per-lane pending depths (admitted, pre-first-token) — the
             # load balancer's view of interactive vs batch pressure.
             doc["lanes"] = self.sched.lane_depths()
+        if self.tracer is not None:
+            # Recorder pressure: a climbing ``dropped`` means traces are
+            # losing their oldest spans — raise recorder_capacity or
+            # lower trace_sample_rate.
+            doc["trace"] = {
+                "depth": self.tracer.depth(),
+                "dropped": self.tracer.dropped,
+            }
         body = json.dumps(doc).encode()
+        writer.write(_response("200 OK", body))
+        await writer.drain()
+
+    async def _debug_trace(self, writer, trace_id: str) -> None:
+        if self.tracer is None:
+            writer.write(_response(
+                "404 Not Found",
+                error_body("tracing is disabled", "invalid_request_error"),
+            ))
+            await writer.drain()
+            return
+        # collect_trace does relay round-trips (trace.pull to every remote
+        # node) — executor, never the accept loop (distcheck DC200).
+        node_spans = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.backend.collect_trace(trace_id)
+        )
+        body = json.dumps(stitch_chrome_trace(trace_id, node_spans)).encode()
+        writer.write(_response("200 OK", body))
+        await writer.drain()
+
+    async def _debug_ticks(self, writer) -> None:
+        # Snapshot takes the recorder lock the engine drive thread also
+        # touches — executor keeps even that blip off the accept loop.
+        ticks = await asyncio.get_running_loop().run_in_executor(
+            None, self.backend.flight_snapshot
+        )
+        body = json.dumps({"ticks": ticks}).encode()
         writer.write(_response("200 OK", body))
         await writer.drain()
 
@@ -394,12 +464,25 @@ class ApiServer:
                 )
                 return
             ticket = decision.ticket
+        # Trace minting: the sampling decision is the zero-cost switch —
+        # an unsampled request carries tctx None and every hook downstream
+        # (backend spans, scheduler queue-wait span, frame headers)
+        # short-circuits on it.
+        tctx = None
+        if self.tracer is not None and self.tcfg is not None:
+            tctx = TraceContext.mint(self.tcfg.trace_sample_rate)
+            if tctx is not None:
+                self.backend.metrics.counter("traces_sampled")
+                if ticket is not None:
+                    ticket.trace = tctx
+        req_t0 = time.time()
         self._inflight += 1
-        # Scheduler off → legacy positional call, so backends that predate
-        # the ticket kwarg (including test stubs) keep working unchanged.
-        if ticket is not None:
+        # Tracing and scheduler off → legacy positional call, so backends
+        # that predate the ticket/trace kwargs (including test stubs) keep
+        # working unchanged.
+        if ticket is not None or tctx is not None:
             handle = self.backend.submit(
-                req.prompt, req.options, deadline, ticket=ticket
+                req.prompt, req.options, deadline, ticket=ticket, trace=tctx
             )
         else:
             handle = self.backend.submit(req.prompt, req.options, deadline)
@@ -419,6 +502,18 @@ class ApiServer:
         finally:
             self._handles.discard(handle)
             self._inflight -= 1
+            if tctx is not None and self.tracer is not None:
+                # The whole-request envelope span: every other gateway
+                # segment (queue wait, route, kv transfer, decode wait)
+                # nests inside it on the stitched timeline.
+                c = tctx.child()
+                self.tracer.record(Span(
+                    "gateway.request", req_t0, time.time() - req_t0,
+                    {"id": req_id, "reason": reason,
+                     "prompt_tokens": len(req.prompt)},
+                    trace_id=c.trace_id, span_id=c.span_id,
+                    parent_id=c.parent_id, node="gateway",
+                ))
             if self.sched is not None and ticket is not None:
                 # Retire the ticket even when the stream died before its
                 # first token — lane depths must not leak.
@@ -477,13 +572,14 @@ class ApiServer:
             req_id, created, self.scfg.model_name, tokens, reason,
             len(req.prompt), self.tokenizer, resumed=resumed,
         )).encode()
-        writer.write(_response("200 OK", payload))
+        writer.write(_response("200 OK", payload,
+                               extra=_trace_id_line(handle)))
         await writer.drain()
         return reason
 
     async def _stream_completion(self, writer, req, handle, deadline,
                                  submit_t, req_id, created) -> str:
-        writer.write(sse_headers())
+        writer.write(sse_headers(extra=_trace_id_line(handle)))
         await writer.drain()
         n_tokens = 0
         reason = "timeout"

@@ -26,11 +26,13 @@ def sse_event(data: Any, seq: Optional[int] = None) -> bytes:
     return b"data: " + json.dumps(data, separators=(",", ":")).encode() + b"\n\n"
 
 
-def sse_headers(status: str = "200 OK") -> bytes:
+def sse_headers(status: str = "200 OK", extra: str = "") -> bytes:
+    """``extra`` carries pre-formatted additional header lines (each
+    ``Name: value\\r\\n``) — e.g. the gateway's ``X-Trace-Id`` echo."""
     return (
         f"HTTP/1.1 {status}\r\n"
         "Content-Type: text/event-stream\r\n"
         "Cache-Control: no-cache\r\n"
         "Connection: close\r\n"
-        "\r\n"
+        f"{extra}\r\n"
     ).encode()

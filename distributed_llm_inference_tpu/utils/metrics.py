@@ -118,6 +118,10 @@ METRICS = {
     "sched_shed_early": ("counter", "Requests shed pre-prefill by deadline"),
     "sched_lane_depth_*": ("gauge", "Pending tickets per admission lane"),
     "sched_queue_wait": ("summary", "Ticket admission to first token"),
+    # distributed request tracing (utils/tracing.py + serving gateway)
+    "traces_sampled": ("counter", "Requests minted a TraceContext"),
+    "trace_spans_dropped": ("counter", "Spans evicted by recorder capacity"),
+    "trace_pull_failures": ("counter", "trace.pull node collections failed"),
     # circuit breaker
     "breaker_state": ("gauge", "0 closed / 1 open / 2 half-open"),
     "breaker_*_transitions": ("counter", "Breaker transitions into a state"),

@@ -13,6 +13,19 @@ model.py:61,82``). This module supplies the two tiers the TPU rebuild needs:
   trace-event JSON (``chrome://tracing`` / Perfetto load it directly), so
   request-level timelines exist even off-TPU and without the profiler
   running.
+* **Distributed request tracing** — :class:`TraceContext` carries a
+  (trace_id, span_id, parent) triple from the gateway across relay frame
+  headers (the flat ``"trace"``/``"span"`` keys, so the distcheck DC500/
+  DC501 closed world sees them); every node records child spans with
+  **epoch** (``time.time``) timestamps into its own recorder, and
+  :func:`stitch_chrome_trace` merges the per-node span sets the
+  ``trace.pull`` collector gathers into ONE Chrome trace-event document —
+  one ``pid`` lane per node, all on the shared epoch clock.
+* **Flight recorder** — :class:`FlightRecorder` keeps a bounded ring of
+  per-engine-tick records (tick kind, occupancy, admitted/chunked/parked
+  rows, dispatch shape, host ms) for the ``/debug/ticks`` endpoint. It is
+  ``None`` on engines without a :class:`~..config.TraceConfig`, so the
+  decode tick pays exactly one attribute load + branch when disabled.
 
 Both tiers are cheap no-ops when idle: ``span`` costs two ``perf_counter``
 calls when no profiler is active, and the recorder is bounded.
@@ -23,9 +36,11 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import random
 import threading
 import time
-from dataclasses import dataclass
+import uuid
+from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
 import jax
@@ -33,7 +48,11 @@ import jax
 __all__ = [
     "Span",
     "SpanRecorder",
+    "TraceContext",
+    "FlightRecorder",
     "span",
+    "trace_span",
+    "stitch_chrome_trace",
     "profile_trace",
     "start_profile",
     "stop_profile",
@@ -43,20 +62,93 @@ __all__ = [
 @dataclass
 class Span:
     name: str
-    start_s: float  # perf_counter timestamp
+    start_s: float  # perf_counter timestamp (epoch for trace spans)
     duration_s: float
     args: Optional[Dict[str, Any]] = None
+    # Distributed-trace attribution (None for plain local spans). Trace
+    # spans use time.time() epoch start_s so spans from different
+    # processes stitch onto one timeline.
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
+    node: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe form for the ``trace.spans`` wire reply."""
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "start_s": self.start_s,
+            "duration_s": self.duration_s,
+        }
+        if self.args:
+            d["args"] = self.args
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
+            d["parent_id"] = self.parent_id
+        if self.node:
+            d["node"] = self.node
+        return d
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One request's position in a distributed trace: which trace it
+    belongs to, the current span, and that span's parent. Immutable —
+    :meth:`child` derives the context a sub-operation records under, and
+    :meth:`to_header` / :meth:`from_header` move it across relay frame
+    headers as the flat ``"trace"`` / ``"span"`` keys."""
+
+    trace_id: str
+    span_id: str = field(default="")
+    parent_id: Optional[str] = None
+
+    @staticmethod
+    def mint(sample_rate: float = 1.0) -> Optional["TraceContext"]:
+        """Gateway entry point: a fresh root context, or ``None`` when the
+        request is not sampled (the whole tracing path then short-circuits
+        on ``is None`` checks — sampling is the zero-cost switch)."""
+        if sample_rate <= 0.0 or random.random() >= sample_rate:
+            return None
+        return TraceContext(
+            trace_id=uuid.uuid4().hex[:16], span_id=uuid.uuid4().hex[:8]
+        )
+
+    def child(self) -> "TraceContext":
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=uuid.uuid4().hex[:8],
+            parent_id=self.span_id,
+        )
+
+    def to_header(self) -> Dict[str, str]:
+        """Flat frame-header keys (merge into an outgoing frame dict)."""
+        return {"trace": self.trace_id, "span": self.span_id}
+
+    @staticmethod
+    def from_header(header: Dict[str, Any]) -> Optional["TraceContext"]:
+        tid = header.get("trace")
+        if not tid:
+            return None
+        return TraceContext(
+            trace_id=str(tid), span_id=str(header.get("span") or "")
+        )
 
 
 class SpanRecorder:
     """Bounded, thread-safe span log with Chrome trace-event export.
 
     The engine's host threads (SURVEY §5.2's concurrency caution) may record
-    concurrently; the newest ``capacity`` spans are kept.
+    concurrently; the newest ``capacity`` spans are kept. Eviction is NOT
+    silent (the repo's "no silent caps" rule): :attr:`dropped` counts
+    evicted spans and, when a ``metrics`` sink is attached, every eviction
+    bumps the ``trace_spans_dropped`` counter.
     """
 
-    def __init__(self, capacity: int = 100_000):
+    def __init__(self, capacity: int = 100_000, metrics=None):
         self.capacity = capacity
+        self.metrics = metrics
+        self.dropped = 0
         self._lock = threading.Lock()
         # deque(maxlen): O(1) append-with-evict — record() sits on the
         # per-decode-step hot path.
@@ -64,11 +156,25 @@ class SpanRecorder:
 
     def record(self, s: Span) -> None:
         with self._lock:
+            evicting = len(self._spans) >= self.capacity
             self._spans.append(s)
+            if evicting:
+                self.dropped += 1
+        if evicting and self.metrics is not None:
+            self.metrics.counter("trace_spans_dropped")
 
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def spans_for(self, trace_id: str) -> List[Span]:
+        """Spans attributed to one distributed trace (collector op)."""
+        with self._lock:
+            return [s for s in self._spans if s.trace_id == trace_id]
 
     def clear(self) -> None:
         with self._lock:
@@ -94,6 +200,101 @@ class SpanRecorder:
     def dump_chrome_trace(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump(self.chrome_trace(), f)
+
+
+@contextlib.contextmanager
+def trace_span(
+    recorder: Optional[SpanRecorder],
+    name: str,
+    ctx: Optional[TraceContext],
+    node: str = "",
+    **args: Any,
+) -> Iterator[Optional[TraceContext]]:
+    """Record one distributed-trace child span (epoch clock).
+
+    Yields the child :class:`TraceContext` the region runs under — put it
+    on outgoing frame headers so remote spans parent correctly. A ``None``
+    recorder or context makes the whole region a no-op yielding ``None``
+    (the unsampled fast path)."""
+    if recorder is None or ctx is None:
+        yield None
+        return
+    child = ctx.child()
+    t0 = time.time()
+    try:
+        yield child
+    finally:
+        # Record even when the region raises — a failed KV transfer or
+        # admission is exactly the segment worth seeing on the timeline.
+        recorder.record(Span(
+            name, t0, time.time() - t0, args or None,
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=child.parent_id, node=node,
+        ))
+
+
+def stitch_chrome_trace(
+    trace_id: str, node_spans: Dict[str, List[Dict[str, Any]]]
+) -> Dict:
+    """Assemble per-node span dicts (``Span.to_dict`` form, as gathered by
+    the ``trace.pull`` collector) into ONE Chrome trace-event document:
+    one ``pid`` lane per node, events on the shared epoch clock, sorted by
+    start time. Nodes that failed to answer the pull are simply absent —
+    a partial trace renders fine, it just has fewer lanes."""
+    events = []
+    for node, spans in sorted(node_spans.items()):
+        for s in spans:
+            if s.get("trace_id") not in (None, trace_id):
+                continue
+            ev = {
+                "name": s.get("name", "?"),
+                "ph": "X",
+                "ts": float(s.get("start_s", 0.0)) * 1e6,
+                "dur": float(s.get("duration_s", 0.0)) * 1e6,
+                "pid": node,
+                "tid": 0,
+            }
+            args = dict(s.get("args") or {})
+            for k in ("span_id", "parent_id"):
+                if s.get(k):
+                    args[k] = s[k]
+            if args:
+                ev["args"] = args
+            events.append(ev)
+    events.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": trace_id, "nodes": sorted(node_spans)},
+    }
+
+
+class FlightRecorder:
+    """Bounded ring of per-engine-tick records — the "what was the engine
+    doing at 14:32:07" tool. The engine appends one dict per ``step()``
+    (tick kind, batch occupancy, admitted/chunked/parked rows, dispatch
+    shape, host ms); ``/debug/ticks`` snapshots the ring. Thread-safe:
+    ``step()`` appends from the drive thread while HTTP handlers read."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._tick = 0
+
+    def record(self, **fields: Any) -> None:
+        with self._lock:
+            fields["tick"] = self._tick
+            fields["t"] = time.time()
+            self._tick += 1
+            self._ring.append(fields)
+
+    def snapshot(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._ring)
+        if last is not None and last > 0:
+            items = items[-last:]
+        return items
 
 
 @contextlib.contextmanager

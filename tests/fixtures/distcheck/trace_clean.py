@@ -1,0 +1,38 @@
+"""Schema-consistent twins of trace_violation.py — zero findings."""
+
+from distributed_llm_inference_tpu.distributed.messages import (
+    pack_frame,
+    unpack_frame,
+)
+
+
+def request_spans(relay, node_queue, tid, reply):
+    relay.put(node_queue, pack_frame({
+        "op": "trace.pull",
+        "trace": tid,
+        "reply": reply,
+    }))
+
+
+def answer_pull(relay, frame, node_id, spans):
+    header, _ = unpack_frame(frame)
+    if header.get("op") != "trace.pull":
+        return
+    reply = header.get("reply")
+    if not reply:
+        return
+    relay.put(reply, pack_frame({
+        "op": "trace.spans",
+        "trace": header.get("trace"),
+        "node": node_id,
+        "spans": spans,
+    }))
+
+
+def collect(frame, tid):
+    header, _ = unpack_frame(frame)
+    if header.get("op") != "trace.spans":
+        return None
+    if header.get("trace") != tid:
+        return None
+    return header.get("node"), header.get("spans")

@@ -1,0 +1,47 @@
+"""Seeded trace-protocol schema drift (DC500, DC501) — test fixture.
+
+The ``trace.pull`` / ``trace.spans`` exchange as a closed world: a
+gateway collector requests one trace's spans from a node, the node
+answers with them riding the JSON header. Two seeded drifts: the node
+stamps a ``span_count`` field nothing reads, and the collector reads a
+``trace_parent`` field nothing writes.
+"""
+
+from distributed_llm_inference_tpu.distributed.messages import (
+    pack_frame,
+    unpack_frame,
+)
+
+
+def request_spans(relay, node_queue, tid, reply):
+    relay.put(node_queue, pack_frame({
+        "op": "trace.pull",
+        "trace": tid,
+        "reply": reply,
+    }))
+
+
+def answer_pull(relay, frame, node_id, spans):
+    header, _ = unpack_frame(frame)
+    if header.get("op") != "trace.pull":
+        return
+    reply = header.get("reply")
+    if not reply:
+        return
+    relay.put(reply, pack_frame({
+        "op": "trace.spans",
+        "trace": header.get("trace"),
+        "node": node_id,
+        "spans": spans,
+        "span_count": len(spans),  # DC501: no consumer reads span_count
+    }))
+
+
+def collect(frame, tid):
+    header, _ = unpack_frame(frame)
+    if header.get("op") != "trace.spans":
+        return None
+    if header.get("trace") != tid:
+        return None
+    parent = header.get("trace_parent")  # DC500: no producer writes it
+    return header.get("node"), header.get("spans"), parent

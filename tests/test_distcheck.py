@@ -92,6 +92,10 @@ _EXPECTED = {
         "DC500": 1,  # consumer reads 'seqno' no producer writes
         "DC501": 1,  # producer writes 'ttl_hint' no consumer reads
     },
+    "trace_violation.py": {
+        "DC500": 1,  # collector reads 'trace_parent' no producer writes
+        "DC501": 1,  # node stamps 'span_count' no consumer reads
+    },
     "lockorder_violation.py": {
         "DC110": 2,  # inverted nesting cycle; declared-order contradiction
         "DC111": 2,  # sleep under lock; socket send via resolved callee
@@ -132,6 +136,7 @@ _CLEAN = [
     "jax_clean.py",
     "metrics_clean.py",
     "frames_clean.py",
+    "trace_clean.py",
     "lockorder_clean.py",
     "lifecycle_clean.py",
     "reply_clean.py",

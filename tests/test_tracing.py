@@ -101,3 +101,136 @@ def test_nested_profile_trace_keeps_outer(tmp_path):
     with tracing.profile_trace(str(tmp_path / "inner")):
         pass  # must NOT stop the outer trace
     assert tracing.stop_profile() == outer  # outer still owned + running
+
+
+# ---------------------------------------------------------------------------
+# distributed request tracing (TraceContext / trace_span / stitch) + the
+# engine flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_mint_child_and_header_round_trip():
+    ctx = tracing.TraceContext.mint(1.0)
+    assert ctx is not None and len(ctx.trace_id) == 16
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+    hdr = {"op": "x", **ctx.to_header()}
+    back = tracing.TraceContext.from_header(hdr)
+    assert back is not None
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+
+
+def test_trace_context_sampling_off_and_none_keys():
+    assert tracing.TraceContext.mint(0.0) is None
+    # Unsampled requests still ship the keys, valued None — the reader
+    # must treat that exactly like an absent context.
+    assert tracing.TraceContext.from_header({"trace": None}) is None
+    assert tracing.TraceContext.from_header({}) is None
+
+
+def test_trace_span_noop_and_recording():
+    rec = tracing.SpanRecorder()
+    with tracing.trace_span(None, "x", tracing.TraceContext.mint(1.0)) as c:
+        assert c is None  # disabled recorder: no-op
+    with tracing.trace_span(rec, "x", None) as c:
+        assert c is None  # unsampled request: no-op
+    assert rec.depth() == 0
+    ctx = tracing.TraceContext.mint(1.0)
+    with tracing.trace_span(rec, "kv_transfer", ctx, node="gw", n=2) as c:
+        assert c is not None and c.parent_id == ctx.span_id
+    (s,) = rec.spans()
+    assert s.name == "kv_transfer" and s.node == "gw"
+    assert s.trace_id == ctx.trace_id and s.parent_id == ctx.span_id
+    assert s.args == {"n": 2}
+    # Spans survive a raising region (failed transfers are the point).
+    try:
+        with tracing.trace_span(rec, "boom", ctx):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert [x.name for x in rec.spans()] == ["kv_transfer", "boom"]
+
+
+def test_span_recorder_counts_evictions():
+    class _Sink:
+        def __init__(self):
+            self.n = 0
+
+        def counter(self, name, inc=1):
+            assert name == "trace_spans_dropped"
+            self.n += inc
+
+    sink = _Sink()
+    rec = tracing.SpanRecorder(capacity=4, metrics=sink)
+    for i in range(10):
+        rec.record(tracing.Span(f"s{i}", 0.0, 0.0))
+    assert rec.depth() == 4
+    assert rec.dropped == 6
+    assert sink.n == 6
+
+
+def test_span_recorder_spans_for_filters_by_trace():
+    rec = tracing.SpanRecorder()
+    rec.record(tracing.Span("a", 0.0, 0.0, trace_id="t1", span_id="s1"))
+    rec.record(tracing.Span("b", 0.0, 0.0, trace_id="t2", span_id="s2"))
+    rec.record(tracing.Span("local", 0.0, 0.0))
+    assert [s.name for s in rec.spans_for("t1")] == ["a"]
+
+
+def test_stitch_chrome_trace_lanes_and_filtering():
+    doc = tracing.stitch_chrome_trace("tid", {
+        "gateway": [
+            {"name": "gateway.request", "start_s": 10.0, "duration_s": 0.5,
+             "trace_id": "tid", "span_id": "a", "parent_id": None},
+            {"name": "other", "start_s": 10.1, "duration_s": 0.1,
+             "trace_id": "OTHER", "span_id": "z"},
+        ],
+        "node-1": [
+            {"name": "decode.first_token", "start_s": 10.2,
+             "duration_s": 0.2, "trace_id": "tid", "span_id": "b",
+             "parent_id": "a", "args": {"gen": "g"}},
+        ],
+    })
+    names = [(e["pid"], e["name"]) for e in doc["traceEvents"]]
+    assert names == [("gateway", "gateway.request"),
+                     ("node-1", "decode.first_token")]  # sorted, filtered
+    assert doc["otherData"]["trace_id"] == "tid"
+    assert doc["otherData"]["nodes"] == ["gateway", "node-1"]
+    ev = doc["traceEvents"][1]
+    assert ev["args"]["parent_id"] == "a" and ev["args"]["gen"] == "g"
+
+
+def test_flight_recorder_ring_is_bounded_with_monotonic_ticks():
+    fr = tracing.FlightRecorder(capacity=8)
+    for i in range(30):
+        fr.record(kind="decode", batch=i)
+    snap = fr.snapshot()
+    assert len(snap) == 8  # bounded
+    assert [r["tick"] for r in snap] == list(range(22, 30))  # no resets
+    assert all("t" in r for r in snap)
+    assert [r["batch"] for r in fr.snapshot(last=2)] == [28, 29]
+
+
+def test_engine_flight_recorder_gated_on_trace_config():
+    from distributed_llm_inference_tpu.config import TraceConfig
+
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, num_kv_heads=2, head_dim=16,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ecfg = EngineConfig(max_batch_size=2, prefill_buckets=(8,),
+                        max_seq_len=32, dtype="float32")
+    off = InferenceEngine(cfg, params, ecfg, CacheConfig(kind="dense"))
+    assert off.flight is None  # disabled path: no ring, no per-tick work
+    on = InferenceEngine(cfg, params, ecfg, CacheConfig(kind="dense"),
+                         trace_cfg=TraceConfig(ticks_capacity=16))
+    on.generate([[1, 2, 3]], SamplingOptions(max_new_tokens=4))
+    ticks = on.flight.snapshot()
+    assert ticks and len(ticks) <= 16
+    assert {t["kind"] for t in ticks} <= {"plain", "pipelined"}, ticks[:3]
+    for t in ticks:
+        assert "occupancy" in t and "admitted" in t and "host_ms" in t
+    assert any(t["occupancy"] > 0 for t in ticks)  # the session decoded

@@ -79,6 +79,16 @@
 # NOT marked 'slow': they are the correctness gate for the one-kernel
 # mixed-phase dispatch path — the parity matrix is what licenses
 # `ragged_attention` defaulting ON for paged TPU engines (~90 s on CPU).
+# The distributed-tracing contract tests (tests/test_tracing.py unit
+# surface + tests/test_trace_e2e.py: cross-node stitch for disagg and
+# fleet-drain re-homes, sampling on/off byte-exact token parity,
+# trace.pull dead-node/chaos degradation to a partial trace, and the
+# /debug/trace + /debug/ticks + X-Trace-Id HTTP surface) are
+# deliberately NOT marked 'slow': the parity and partial-trace cases are
+# what license tracing defaulting ON at the gateway — keep new cases
+# under a few seconds each (tiny model, short streams, one drain) or
+# move them to 'slow' so the observability tier never eats the budget
+# the correctness suites need.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
